@@ -1,0 +1,28 @@
+"""peritext-tpu: a TPU-native rich-text CRDT framework.
+
+Capabilities match inkandswitch/peritext (see SURVEY.md): a convergent CRDT
+for collaboratively edited rich text with inline formatting, replication via
+causally-gated change logs, incremental patch streams, stable cursors, trace
+replay, fuzzing — plus a batched, jit-compiled merge engine that scales over
+TPU device meshes.
+
+Layers:
+- ``peritext_tpu.oracle``  — exact scalar semantics (host front-end + oracle)
+- ``peritext_tpu.ops``     — tensorized document state and jitted kernels
+- ``peritext_tpu.parallel``— replica-batch sharding over device meshes
+- ``peritext_tpu.runtime`` — replication plumbing (queues, pubsub, logs,
+                              checkpointing)
+"""
+from peritext_tpu.oracle import Doc, accumulate_patches
+from peritext_tpu.schema import ALL_MARKS, MARK_SPEC, MARK_TYPE_ID
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Doc",
+    "accumulate_patches",
+    "ALL_MARKS",
+    "MARK_SPEC",
+    "MARK_TYPE_ID",
+    "__version__",
+]

@@ -1,0 +1,55 @@
+"""Declarative mark schema — the config table that drives mark semantics.
+
+This is the equivalent of the reference's ProseMirror ``markSpec``
+(/root/reference/src/schema.ts:45-96): a tiny static table consumed by the
+formatting engine.  Two flags drive the whole algorithm:
+
+- ``inclusive``: the mark's *end* grows to absorb text typed at its right
+  boundary (bold/italic do; links and comments don't).  Consumed when anchoring
+  mark endpoints (see :func:`peritext_tpu.oracle.doc.change_mark`, reference
+  peritext.ts:466-467).
+- ``allow_multiple``: overlapping same-type marks coexist as a set (comments)
+  instead of resolving last-writer-wins (reference peritext.ts:304, schema.ts:77).
+
+Because the table is static, the TPU engine bakes it into compiled kernels as
+integer constants (`INCLUSIVE_BY_ID` / `ALLOW_MULTIPLE_BY_ID` arrays), so mark
+semantics cost nothing at runtime.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Tuple
+
+
+@dataclass(frozen=True)
+class MarkSpec:
+    """Configuration for one mark type (reference schema.ts:45-96)."""
+
+    inclusive: bool
+    allow_multiple: bool
+    attr_keys: Tuple[str, ...] = ()
+
+
+# The four mark types of the reference schema, in declaration order.
+# Reference: schema.ts:46-95 and ALL_MARKS at schema.ts:125.
+MARK_SPEC: Mapping[str, MarkSpec] = {
+    "strong": MarkSpec(inclusive=True, allow_multiple=False),
+    "em": MarkSpec(inclusive=True, allow_multiple=False),
+    "comment": MarkSpec(inclusive=False, allow_multiple=True, attr_keys=("id",)),
+    "link": MarkSpec(inclusive=False, allow_multiple=False, attr_keys=("url",)),
+}
+
+ALL_MARKS: Tuple[str, ...] = tuple(MARK_SPEC)
+
+# Integer ids for mark types, used by the tensorized engine.
+MARK_TYPE_ID = {name: i for i, name in enumerate(ALL_MARKS)}
+NUM_MARK_TYPES = len(ALL_MARKS)
+
+# Dense views of the schema flags, indexable by mark-type id inside kernels.
+INCLUSIVE_BY_ID = tuple(MARK_SPEC[t].inclusive for t in ALL_MARKS)
+ALLOW_MULTIPLE_BY_ID = tuple(MARK_SPEC[t].allow_multiple for t in ALL_MARKS)
+
+
+def is_mark_type(s: str) -> bool:
+    """Reference schema.ts:133-140 (isMarkType)."""
+    return s in MARK_SPEC

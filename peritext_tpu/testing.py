@@ -1,0 +1,88 @@
+"""Test fixtures shared by the framework's test suite and fuzzer.
+
+Mirrors the reference's fixtures: generateDocs (test/generateDocs.ts:11-42)
+and the concurrent-write harness shape (test/micromerge.ts:46-86).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from peritext_tpu.oracle import Doc, accumulate_patches
+
+DEFAULT_TEXT = "The Peritext editor"
+
+
+def generate_docs(
+    text: str = DEFAULT_TEXT, count: int = 2
+) -> Tuple[List[Doc], List[List[Dict[str, Any]]], Dict[str, Any]]:
+    """N synced replicas bootstrapped from a single genesis change.
+
+    Reference test/generateDocs.ts:11-42: doc1 originates one change holding
+    makeList + the initial insert; every other replica applies it, so all
+    replicas share root structure (also the initializeDocs rule,
+    bridge.ts:106-120).
+    """
+    docs = [Doc(f"doc{i + 1}") for i in range(count)]
+    patches: List[List[Dict[str, Any]]] = [[] for _ in range(count)]
+    initial_change, initial_patches = docs[0].change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list(text)},
+        ]
+    )
+    patches[0] = initial_patches
+    for i in range(1, count):
+        patches[i] = docs[i].apply_change(initial_change)
+    return docs, patches, initial_change
+
+
+def run_concurrent(
+    *,
+    initial_text: str = DEFAULT_TEXT,
+    pre_ops: Optional[Sequence[Dict[str, Any]]] = None,
+    input_ops1: Sequence[Dict[str, Any]] = (),
+    input_ops2: Sequence[Dict[str, Any]] = (),
+) -> Dict[str, Any]:
+    """Concurrently apply two op sequences to two replicas and cross-sync.
+
+    Reference test harness testConcurrentWrites (test/micromerge.ts:46-86).
+    Returns the materialized spans from both replicas' batch codepaths and
+    from both replicas' accumulated patch streams; callers assert all four
+    equal the expected spans (the dual-path-equivalence invariant).
+    """
+    docs, patches, _ = generate_docs(initial_text)
+    doc1, doc2 = docs
+    patches1, patches2 = patches
+
+    def with_path(ops: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        return [{**op, "path": ["text"]} for op in ops]
+
+    if pre_ops:
+        change0, p0 = doc1.change(with_path(pre_ops))
+        patches1 = patches1 + p0
+        patches2 = patches2 + doc2.apply_change(change0)
+
+    change1, p1 = doc1.change(with_path(input_ops1))
+    patches1 = patches1 + p1
+    change2, p2 = doc2.change(with_path(input_ops2))
+    patches2 = patches2 + p2
+
+    patches2 = patches2 + doc2.apply_change(change1)
+    patches1 = patches1 + doc1.apply_change(change2)
+
+    return {
+        "docs": (doc1, doc2),
+        "batch1": doc1.get_text_with_formatting(["text"]),
+        "batch2": doc2.get_text_with_formatting(["text"]),
+        "patch1": accumulate_patches(patches1),
+        "patch2": accumulate_patches(patches2),
+        "patches": (patches1, patches2),
+    }
+
+
+def assert_converges(result: Dict[str, Any], expected: Sequence[Dict[str, Any]]) -> None:
+    expected = list(expected)
+    assert result["batch1"] == expected, f"doc1 batch: {result['batch1']} != {expected}"
+    assert result["batch2"] == expected, f"doc2 batch: {result['batch2']} != {expected}"
+    assert result["patch1"] == expected, f"doc1 patches: {result['patch1']} != {expected}"
+    assert result["patch2"] == expected, f"doc2 patches: {result['patch2']} != {expected}"

@@ -1,0 +1,12 @@
+"""Test configuration: force a virtual 8-device CPU platform for JAX.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (the driver separately dry-run-compiles the multi-chip path
+via __graft_entry__.dryrun_multichip).  Must run before any jax import.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,809 @@
+"""Golden behavior matrix, ported from the reference test suite.
+
+Every test corresponds to a case in /root/reference/test/micromerge.ts
+(cited per test).  These are the must-pass behaviors for the framework; the
+same matrix runs against the TPU engine in test_engine_examples.py.
+"""
+from peritext_tpu.oracle import Doc
+from peritext_tpu.testing import assert_converges, generate_docs, run_concurrent
+
+B = {"active": True}  # strong/em mark value
+
+
+def check(expected, **kwargs):
+    assert_converges(run_concurrent(**kwargs), expected)
+
+
+# -- plain text (test/micromerge.ts:89-139) ---------------------------------
+
+
+def test_insert_and_delete_text():
+    docs, _, _ = generate_docs("abcde")
+    doc1 = docs[0]
+    doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 3}])
+    assert "".join(doc1.root["text"]) == "de"
+
+
+def test_local_changes_recorded_in_deps_clock():
+    docs, _, _ = generate_docs("a")
+    doc1, doc2 = docs
+    change2, _ = doc2.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": ["b"]}]
+    )
+    doc1.apply_change(change2)  # must not raise
+    assert doc1.root["text"] == ["a", "b"]
+    assert doc2.root["text"] == ["a", "b"]
+
+
+def test_concurrent_deletion_and_insertion():
+    check(
+        [{"marks": {}, "text": "abracadabra"}],
+        initial_text="abrxabra",
+        input_ops1=[
+            {"action": "delete", "index": 3, "count": 1},
+            {"action": "insert", "index": 4, "values": ["c", "a"]},
+        ],
+        input_ops2=[{"action": "insert", "index": 5, "values": ["d", "a"]}],
+    )
+
+
+# -- basic marks (test/micromerge.ts:141-299) -------------------------------
+
+
+def test_flattens_local_formatting_into_spans():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"strong": B}, "text": "Peritext"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+    )
+
+
+def test_concurrent_overlapping_bold_and_italic():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "The "},
+            {"marks": {"strong": B, "em": B}, "text": "Peritext"},
+            {"marks": {"em": B}, "text": " editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}
+        ],
+    )
+
+
+def test_insert_at_end_and_italic_to_end():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "The "},
+            {"marks": {"strong": B, "em": B}, "text": "Peritext"},
+            {"marks": {"em": B}, "text": " editor is great!"},
+        ],
+        initial_text="The Peritext editor",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 19, "values": list(" is great!")},
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}
+        ],
+    )
+
+
+def test_concurrent_bold_and_unbold():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "The "},
+            {"marks": {}, "text": "Peritext editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "removeMark", "startIndex": 4, "endIndex": 19, "markType": "strong"}
+        ],
+    )
+
+
+def test_unbold_inside_bold():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "The "},
+            {"marks": {}, "text": "Peritext"},
+            {"marks": {"strong": B}, "text": " editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 19, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "removeMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+    )
+
+
+def test_unbold_single_character():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "The "},
+            {"marks": {}, "text": "P"},
+            {"marks": {"strong": B}, "text": "eritext editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 19, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "removeMark", "startIndex": 4, "endIndex": 5, "markType": "strong"}
+        ],
+    )
+
+
+def test_zero_width_collapsed_span():
+    check(
+        [{"marks": {}, "text": "The x editor"}],
+        pre_ops=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "delete", "index": 4, "count": 8},
+        ],
+        input_ops1=[{"action": "insert", "index": 4, "values": ["x"]}],
+    )
+
+
+# -- span growth, single actor (test/micromerge.ts:323-567) -----------------
+
+
+def test_bold_grows_right():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"strong": B}, "text": "Peritext!"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+    )
+
+
+def test_bold_does_not_grow_left():
+    check(
+        [
+            {"marks": {}, "text": "The !"},
+            {"marks": {"strong": B}, "text": "Peritext"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 4, "values": ["!"]},
+        ],
+    )
+
+
+def test_link_does_not_grow_right():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"link": {"url": "inkandswitch.com"}}, "text": "Peritext"},
+            {"marks": {}, "text": "! editor"},
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+    )
+
+
+def test_link_does_not_grow_left():
+    check(
+        [
+            {"marks": {}, "text": "The !"},
+            {"marks": {"link": {"url": "inkandswitch.com"}}, "text": "Peritext"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "insert", "index": 4, "values": ["!"]},
+        ],
+    )
+
+
+def test_grows_only_bold_when_bold_and_link_end_together():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {
+                "marks": {"link": {"url": "inkandswitch.com"}, "strong": B},
+                "text": "Peritext",
+            },
+            {"marks": {"strong": B}, "text": "!"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+    )
+
+
+def test_adjacent_bold_and_unbold_growth():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "AF"},
+            {"marks": {}, "text": "BCDG"},
+            {"marks": {"strong": B}, "text": "E"},
+        ],
+        initial_text="ABCDE",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 5, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 1, "endIndex": 4, "markType": "strong"},
+            {"action": "insert", "index": 1, "values": ["F"]},
+            {"action": "insert", "index": 5, "values": ["G"]},
+        ],
+    )
+
+
+def test_growth_with_tombstone_boundary():
+    check(
+        [
+            {"marks": {}, "text": "A"},
+            {"marks": {"link": {"url": "inkandswitch.com"}}, "text": "C"},
+            {"marks": {}, "text": "FE"},
+        ],
+        initial_text="ABCDE",
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 1,
+                "endIndex": 4,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "delete", "index": 1, "count": 1},
+            {"action": "delete", "index": 2, "count": 1},
+            {"action": "insert", "index": 2, "values": ["F"]},
+        ],
+    )
+
+
+# -- span growth with concurrent edits (test/micromerge.ts:569-709) ---------
+
+
+def test_concurrent_bold_and_insertion_at_boundary():
+    check(
+        [
+            {"marks": {}, "text": "The *"},
+            {"marks": {"strong": B}, "text": "Peritext*"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "insert", "index": 4, "values": ["*"]},
+            {"action": "insert", "index": 13, "values": ["*"]},
+        ],
+    )
+
+
+def test_insertion_where_one_mark_ends_and_another_begins():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"strong": B}, "text": "Peritext[1]"},
+            {"marks": {"em": B}, "text": " editor"},
+        ],
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "addMark", "startIndex": 12, "endIndex": 19, "markType": "em"},
+        ],
+        input_ops2=[{"action": "insert", "index": 12, "values": list("[1]")}],
+    )
+
+
+def test_insertion_at_bold_unbold_boundary():
+    check(
+        [
+            {"marks": {"strong": B}, "text": "AB"},
+            {"marks": {}, "text": "C"},
+        ],
+        initial_text="AC",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 1, "endIndex": 2, "markType": "strong"},
+        ],
+        input_ops2=[{"action": "insert", "index": 1, "values": ["B"]}],
+    )
+
+
+def test_insertion_at_unbold_bold_boundary():
+    check(
+        [
+            {"marks": {}, "text": "AB"},
+            {"marks": {"strong": B}, "text": "C"},
+        ],
+        initial_text="AC",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 0, "endIndex": 1, "markType": "strong"},
+        ],
+        input_ops2=[{"action": "insert", "index": 1, "values": ["B"]}],
+    )
+
+
+def test_concurrent_adjacent_formatting_ops():
+    check(
+        [
+            {"marks": {}, "text": "A"},
+            {"marks": {"strong": B}, "text": "BC"},
+            {"marks": {}, "text": "DE"},
+        ],
+        initial_text="ABCDE",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 1, "endIndex": 2, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 2, "endIndex": 3, "markType": "strong"}
+        ],
+    )
+
+
+# -- tombstones and deleted content (test/micromerge.ts:711-910) ------------
+
+
+def test_addmark_boundary_is_tombstone():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"strong": B}, "text": "_Peritext_"},
+            {"marks": {}, "text": " editor"},
+        ],
+        initial_text="The *Peritext* editor",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 14, "markType": "strong"},
+            {"action": "delete", "index": 4, "count": 1},
+            {"action": "delete", "index": 12, "count": 1},
+        ],
+        input_ops2=[
+            {"action": "insert", "index": 5, "values": ["_"]},
+            {"action": "insert", "index": 14, "values": ["_"]},
+        ],
+    )
+
+
+def test_insertion_into_deleted_span_with_mark():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"strong": B}, "text": "ara"},
+            {"marks": {}, "text": " editor"},
+        ],
+        pre_ops=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops1=[{"action": "delete", "index": 4, "count": 8}],
+        input_ops2=[
+            {"action": "delete", "index": 5, "count": 3},
+            {"action": "insert", "index": 5, "values": list("ara")},
+        ],
+    )
+
+
+def test_formatting_on_deleted_span():
+    check(
+        [{"marks": {}, "text": "The editor"}],
+        input_ops1=[{"action": "delete", "index": 4, "count": 9}],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 5, "endIndex": 11, "markType": "strong"}
+        ],
+    )
+
+
+def test_formatting_on_single_character():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"strong": B}, "text": "P"},
+            {"marks": {}, "text": "eritext editor"},
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 5, "markType": "strong"}
+        ],
+    )
+
+
+def test_formatting_on_single_deleted_character():
+    check(
+        [{"marks": {}, "text": "ABDE"}],
+        initial_text="ABCDE",
+        input_ops1=[{"action": "delete", "index": 2, "count": 1}],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 2,
+                "endIndex": 3,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            }
+        ],
+    )
+
+
+def test_mark_starts_and_ends_after_visible_sequence():
+    check(
+        [
+            {"marks": {}, "text": "A"},
+            {"marks": {"link": {"url": "A.com"}}, "text": "D"},
+        ],
+        initial_text="ABCDE",
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 2,
+                "endIndex": 4,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            },
+            {"action": "delete", "index": 1, "count": 2},
+            {"action": "delete", "index": 2, "count": 1},
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 3,
+                "endIndex": 5,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            }
+        ],
+    )
+
+
+def test_mark_ends_after_visible_sequence():
+    check(
+        [
+            {"marks": {}, "text": "ABC"},
+            {"marks": {"link": {"url": "A.com"}}, "text": "D"},
+        ],
+        initial_text="ABCDE",
+        input_ops1=[{"action": "delete", "index": 4, "count": 1}],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 3,
+                "endIndex": 5,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            }
+        ],
+    )
+
+
+# -- patches (test/micromerge.ts:912-1030) ----------------------------------
+
+
+def test_patch_simple_insertion():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    input_ops = [
+        {"path": ["text"], "action": "insert", "index": 7, "values": ["a"]}
+    ]
+    change, _ = doc1.change(input_ops)
+    patch = doc2.apply_change(change)
+    assert patch == [{**op, "marks": {}} for op in input_ops]
+
+
+def test_patch_adjusted_insertion_index_on_concurrent_inserts():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": ["a", "b", "c"]}]
+    )
+    change2, _ = doc2.change(
+        [{"path": ["text"], "action": "insert", "index": 2, "values": ["b"]}]
+    )
+    patch = doc1.apply_change(change2)
+    assert patch == [
+        {
+            "path": ["text"],
+            "action": "insert",
+            "index": 5,
+            "values": ["b"],
+            "marks": {},
+        }
+    ]
+
+
+def test_patch_simple_deletion():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    input_ops = [{"path": ["text"], "action": "delete", "index": 5, "count": 1}]
+    change, _ = doc1.change(input_ops)
+    patch = doc2.apply_change(change)
+    assert patch == input_ops
+
+
+def test_patch_multichar_deletion_becomes_single_char_deletions():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    change, _ = doc1.change(
+        [{"path": ["text"], "action": "delete", "index": 5, "count": 2}]
+    )
+    patch = doc2.apply_change(change)
+    assert patch == [
+        {"path": ["text"], "action": "delete", "index": 5, "count": 1},
+        {"path": ["text"], "action": "delete", "index": 5, "count": 1},
+    ]
+
+
+# -- comments (test/micromerge.ts:1032-1143) --------------------------------
+
+
+def test_single_comment_in_flattened_spans():
+    docs, _, _ = generate_docs()
+    doc1 = docs[0]
+    doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            }
+        ]
+    )
+    assert doc1.root["text"] == list("The Peritext editor")
+    assert doc1.get_text_with_formatting(["text"]) == [
+        {"marks": {}, "text": "The "},
+        {"marks": {"comment": [{"id": "abc-123"}]}, "text": "Peritext"},
+        {"marks": {}, "text": " editor"},
+    ]
+
+
+def test_two_comments_same_user():
+    docs, _, _ = generate_docs()
+    doc1 = docs[0]
+    doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            },
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "comment",
+                "attrs": {"id": "def-789"},
+            },
+        ]
+    )
+    assert doc1.get_text_with_formatting(["text"]) == [
+        {"marks": {"comment": [{"id": "abc-123"}]}, "text": "The "},
+        {"marks": {"comment": [{"id": "abc-123"}, {"id": "def-789"}]}, "text": "Peritext"},
+        {"marks": {"comment": [{"id": "def-789"}]}, "text": " editor"},
+    ]
+
+
+def test_overlapping_comments_from_different_users():
+    check(
+        [
+            {"marks": {"comment": [{"id": "abc-123"}]}, "text": "The "},
+            {
+                "marks": {"comment": [{"id": "abc-123"}, {"id": "def-789"}]},
+                "text": "Peritext",
+            },
+            {"marks": {"comment": [{"id": "def-789"}]}, "text": " editor"},
+        ],
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "comment",
+                "attrs": {"id": "def-789"},
+            }
+        ],
+    )
+
+
+# -- links (test/micromerge.ts:1145-1288) -----------------------------------
+
+
+def test_single_link_in_flattened_spans():
+    docs, _, _ = generate_docs()
+    doc1 = docs[0]
+    doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ]
+    )
+    assert doc1.get_text_with_formatting(["text"]) == [
+        {"marks": {}, "text": "The "},
+        {"marks": {"link": {"url": "https://inkandswitch.com"}}, "text": "Peritext"},
+        {"marks": {}, "text": " editor"},
+    ]
+
+
+def test_link_lww_full_overlap():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"link": {"url": "https://google.com"}}, "text": "Peritext"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+    )
+
+
+def test_link_lww_partial_overlap():
+    check(
+        [
+            {"marks": {"link": {"url": "https://inkandswitch.com"}}, "text": "The "},
+            {"marks": {"link": {"url": "https://google.com"}}, "text": "Peritext editor"},
+        ],
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+    )
+
+
+def test_links_converge_when_ending_at_same_place():
+    check(
+        [
+            {"marks": {}, "text": "The "},
+            {"marks": {"link": {"url": "https://google.com"}}, "text": "Peritext"},
+            {"marks": {}, "text": " editor"},
+        ],
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 11,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+    )
+
+
+# -- cursors (test/micromerge.ts:1290-1417) ---------------------------------
+
+
+def _cursor_doc():
+    docs, _, _ = generate_docs()
+    return docs[0]
+
+
+def test_cursor_resolves():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    assert doc1.resolve_cursor(cursor) == 5
+
+
+def test_cursor_moves_right_on_insert_before():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["a", "b", "c"]}]
+    )
+    assert doc1.resolve_cursor(cursor) == 8
+
+
+def test_cursor_stays_on_insert_after():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 7, "values": ["a", "b", "c"]}]
+    )
+    assert doc1.resolve_cursor(cursor) == 5
+
+
+def test_cursor_moves_left_on_delete_before():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 3}])
+    assert doc1.resolve_cursor(cursor) == 2
+
+
+def test_cursor_stays_on_delete_after():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change([{"path": ["text"], "action": "delete", "index": 7, "count": 3}])
+    assert doc1.resolve_cursor(cursor) == 5
+
+
+def test_cursor_collapses_to_zero_when_prefix_deleted():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 7}])
+    assert doc1.resolve_cursor(cursor) == 0
