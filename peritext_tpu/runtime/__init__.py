@@ -1,0 +1,19 @@
+"""Replication runtime: queues, pub/sub, change logs, anti-entropy sync.
+
+The host-side control plane of the framework — the equivalents of the
+reference's pubsub.ts / changeQueue.ts / test-merge.ts layer (SURVEY.md §2.4).
+The data plane (batched op application) lives in ``peritext_tpu.ops``.
+"""
+from peritext_tpu.runtime.log import ChangeLog
+from peritext_tpu.runtime.pubsub import Publisher
+from peritext_tpu.runtime.queue import ChangeQueue
+from peritext_tpu.runtime.sync import apply_changes, causal_sort, sync_pair
+
+__all__ = [
+    "ChangeLog",
+    "Publisher",
+    "ChangeQueue",
+    "apply_changes",
+    "causal_sort",
+    "sync_pair",
+]

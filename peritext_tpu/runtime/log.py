@@ -1,0 +1,71 @@
+"""Append-only per-actor change logs — the durable replication substrate.
+
+Reference: the fuzzer's ``SharedHistory`` (test/fuzz.ts:160-163) and the
+vector-clock diff ``getMissingChanges`` (test/merge.ts:25-38).  A change log
+is the CRDT's only durable state: any replica is reconstructible by replaying
+logs through ``apply_change`` (this is exactly how the reference's failure
+traces work — they serialize ``queues``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping
+
+Change = Dict[str, Any]
+Clock = Mapping[str, int]
+
+
+class ChangeLog:
+    """Per-actor append-only sequences of changes, indexed by seq."""
+
+    def __init__(self) -> None:
+        self._queues: Dict[str, List[Change]] = {}
+
+    def append(self, change: Change) -> None:
+        queue = self._queues.setdefault(change["actor"], [])
+        expected = len(queue) + 1
+        if change["seq"] != expected:
+            raise ValueError(
+                f"Log gap for {change['actor']}: expected seq {expected}, got {change['seq']}"
+            )
+        queue.append(change)
+
+    def record(self, change: Change) -> None:
+        """Append if this change extends the log; ignore if already present."""
+        queue = self._queues.setdefault(change["actor"], [])
+        if change["seq"] == len(queue) + 1:
+            queue.append(change)
+        elif change["seq"] > len(queue) + 1:
+            raise ValueError(
+                f"Log gap for {change['actor']}: have {len(queue)}, got seq {change['seq']}"
+            )
+
+    def clock(self) -> Dict[str, int]:
+        return {actor: len(queue) for actor, queue in self._queues.items()}
+
+    def changes_for(self, actor: str) -> List[Change]:
+        return list(self._queues.get(actor, []))
+
+    def missing_changes(self, source_clock: Clock, target_clock: Clock) -> List[Change]:
+        """Changes the source has seen that the target hasn't.
+
+        Reference test/merge.ts:25-38 (getMissingChanges): vector-clock diff,
+        pulling from the per-actor queues.
+        """
+        changes: List[Change] = []
+        for actor, count in source_clock.items():
+            have = target_clock.get(actor)
+            if have is None:
+                changes.extend(self._queues.get(actor, [])[:count])
+            elif have < count:
+                changes.extend(self._queues.get(actor, [])[have:count])
+        return changes
+
+    def all_changes(self) -> List[Change]:
+        out: List[Change] = []
+        for queue in self._queues.values():
+            out.extend(queue)
+        return out
+
+    @property
+    def actors(self) -> List[str]:
+        return list(self._queues)

@@ -1,0 +1,31 @@
+"""In-process publish/subscribe fan-out.
+
+Reference: /root/reference/src/pubsub.ts:1-26 (Publisher).  ``publish`` fans an
+update out to every subscriber except the sender.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class Publisher(Generic[T]):
+    def __init__(self) -> None:
+        self._subscribers: Dict[str, Callable[[T], None]] = {}
+
+    def subscribe(self, key: str, callback: Callable[[T], None]) -> None:
+        if key in self._subscribers:
+            raise ValueError(f"Subscriber already exists: {key}")
+        self._subscribers[key] = callback
+
+    def unsubscribe(self, key: str) -> None:
+        if key not in self._subscribers:
+            raise ValueError(f"Subscriber not found: {key}")
+        del self._subscribers[key]
+
+    def publish(self, sender: str, update: T) -> None:
+        for key, callback in list(self._subscribers.items()):
+            if key == sender:
+                continue
+            callback(update)

@@ -1,0 +1,63 @@
+"""Outbound change batching queue.
+
+Reference: /root/reference/src/changeQueue.ts:6-52 (ChangeQueue).  Batches
+locally generated changes and flushes them through a handler — the host->device
+staging-buffer analog in the TPU engine, and the network-batching analog in
+replication.  The reference flushes on a 10ms browser timer (tunable to
+simulate latency); here the timer is an optional daemon thread, and manual
+``flush()`` covers the demo-style "manual sync button" mode
+(reference index.ts:119-128).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class ChangeQueue:
+    def __init__(
+        self,
+        handle_flush: Callable[[List[Any]], None],
+        interval: float = 0.01,
+    ) -> None:
+        self._changes: List[Any] = []
+        self._handle_flush = handle_flush
+        self._interval = interval
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+
+    def enqueue(self, *changes: Any) -> None:
+        with self._lock:
+            self._changes.extend(changes)
+
+    def flush(self) -> None:
+        with self._lock:
+            changes, self._changes = self._changes, []
+        self._handle_flush(changes)
+
+    def _tick(self) -> None:
+        self.flush()
+        with self._lock:
+            if self._timer is not None:
+                self._timer = threading.Timer(self._interval, self._tick)
+                self._timer.daemon = True
+                self._timer.start()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                return
+            self._timer = threading.Timer(self._interval, self._tick)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def drop(self) -> None:
+        """Stop the timer (go manual-sync).  Reference changeQueue.ts:47-51."""
+        with self._lock:
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._changes)

@@ -1,0 +1,84 @@
+"""Anti-entropy synchronization between replicas.
+
+Reference: test/merge.ts:4-23 (applyChanges retry loop) and 25-38
+(getMissingChanges).  The reference tolerates out-of-order delivery by
+retrying causally-unready changes in a queue with a divergence guard; we also
+provide :func:`causal_sort`, which topologically orders a batch up front so
+the TPU engine can apply it in one pass with no retries — the "pre-sort by
+Lamport key + deps check before kernel launch" design (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Sequence
+
+Change = Dict[str, Any]
+
+
+def apply_changes(doc: Any, changes: Sequence[Change]) -> List[Dict[str, Any]]:
+    """Apply changes tolerating causal gaps, retrying until convergence.
+
+    Reference test/merge.ts:4-23: unready changes rotate to the back of the
+    queue; a 10k-iteration guard detects divergence (e.g. genuinely missing
+    dependencies).
+    """
+    pending = deque(changes)
+    patches: List[Dict[str, Any]] = []
+    iterations = 0
+    while pending:
+        change = pending.popleft()
+        try:
+            patches.extend(doc.apply_change(change))
+        except ValueError:
+            pending.append(change)
+        iterations += 1
+        if iterations > 10000:
+            raise RuntimeError("apply_changes did not converge")
+    return patches
+
+
+def causal_sort(changes: Sequence[Change], clock: Dict[str, int] | None = None) -> List[Change]:
+    """Order a batch of changes so each one's causal dependencies precede it.
+
+    Kahn's algorithm over the (actor seq-chain + deps) DAG, seeded with the
+    receiving replica's current ``clock``.  Ties broken by (startOp, actor)
+    for determinism.  Raises ``ValueError`` if the batch has unsatisfiable
+    dependencies — the batched-engine analog of the reference's
+    causal-readiness throw (micromerge.ts:501-509).
+    """
+    clock = dict(clock or {})
+    remaining = sorted(changes, key=lambda c: (c["startOp"], c["actor"], c["seq"]))
+    ordered: List[Change] = []
+    progress = True
+    while remaining and progress:
+        progress = False
+        deferred: List[Change] = []
+        for change in remaining:
+            ready = clock.get(change["actor"], 0) == change["seq"] - 1 and all(
+                clock.get(actor, 0) >= dep for actor, dep in (change.get("deps") or {}).items()
+            )
+            if ready:
+                clock[change["actor"]] = change["seq"]
+                ordered.append(change)
+                progress = True
+            else:
+                deferred.append(change)
+        remaining = deferred
+    if remaining:
+        raise ValueError(
+            f"causal_sort: {len(remaining)} changes have unsatisfiable dependencies"
+        )
+    return ordered
+
+
+def sync_pair(log: Any, left: Any, right: Any) -> tuple[list, list]:
+    """Anti-entropy sync between two replicas through a shared change log.
+
+    Returns (patches applied to left, patches applied to right).  This is the
+    reference fuzzer's sync step (fuzz.ts:181-202).
+    """
+    to_right = log.missing_changes(left.clock, right.clock)
+    to_left = log.missing_changes(right.clock, left.clock)
+    right_patches = apply_changes(right, to_right)
+    left_patches = apply_changes(left, to_left)
+    return left_patches, right_patches

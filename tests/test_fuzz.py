@@ -1,0 +1,19 @@
+"""Seeded fuzz runs (the CI-sized slice of the unbounded fuzz loop)."""
+import pytest
+
+from peritext_tpu.fuzz import fuzz
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fuzz_converges(seed):
+    fuzz(iterations=150, seed=seed)
+
+
+def test_fuzz_with_comment_removal_converges():
+    # The reference never fuzzed comment removal (fuzz.ts:78 builds addMark);
+    # under this engine's per-id LWW comment semantics it must converge.
+    fuzz(iterations=150, seed=11, allow_comment_remove=True, check_patches=False)
+
+
+def test_fuzz_larger_doc():
+    fuzz(iterations=100, seed=5, initial_text="The quick brown fox", max_insert_chars=4)

@@ -1,0 +1,123 @@
+"""Replication layer: queues, pubsub, logs, anti-entropy, causal ordering."""
+import pytest
+
+from peritext_tpu.oracle import Doc, accumulate_patches
+from peritext_tpu.runtime import ChangeLog, ChangeQueue, Publisher, apply_changes, causal_sort
+from peritext_tpu.testing import generate_docs
+
+
+def test_publisher_fans_out_except_sender():
+    pub = Publisher()
+    seen = {"a": [], "b": [], "c": []}
+    for key in seen:
+        pub.subscribe(key, lambda update, key=key: seen[key].append(update))
+    pub.publish("a", "hello")
+    assert seen == {"a": [], "b": ["hello"], "c": ["hello"]}
+    with pytest.raises(ValueError):
+        pub.subscribe("a", lambda update: None)
+    pub.unsubscribe("b")
+    pub.publish("c", "again")
+    assert seen["a"] == ["again"] and seen["b"] == ["hello"]
+
+
+def test_change_queue_batches_until_flush():
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.append)
+    queue.enqueue({"seq": 1}, {"seq": 2})
+    queue.enqueue({"seq": 3})
+    assert len(queue) == 3
+    queue.flush()
+    assert flushed == [[{"seq": 1}, {"seq": 2}, {"seq": 3}]]
+    queue.flush()
+    assert flushed[-1] == []
+
+
+def test_change_log_clock_and_missing_changes():
+    docs, _, initial = generate_docs("hi", count=3)
+    log = ChangeLog()
+    log.record(initial)
+    c2, _ = docs[1].change(
+        [{"path": ["text"], "action": "insert", "index": 2, "values": ["!"]}]
+    )
+    log.record(c2)
+    assert log.clock() == {"doc1": 1, "doc2": 1}
+    # doc3 has only seen the genesis change
+    missing = log.missing_changes(docs[1].clock, docs[2].clock)
+    assert [c["actor"] for c in missing] == ["doc2"]
+    # idempotent record
+    log.record(c2)
+    assert log.clock()["doc2"] == 1
+    with pytest.raises(ValueError):
+        log.record({"actor": "doc2", "seq": 5, "deps": {}, "startOp": 99, "ops": []})
+
+
+def test_apply_changes_tolerates_out_of_order_delivery():
+    docs, _, initial = generate_docs("abc")
+    doc1, _ = docs
+    c1, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 3, "values": ["d"]}])
+    c2, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 4, "values": ["e"]}])
+    c3, _ = doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 1}])
+    fresh = Doc("fresh")
+    patches = apply_changes(fresh, [c3, c2, c1, initial])  # fully reversed
+    assert "".join(fresh.root["text"]) == "bcde"
+    assert accumulate_patches(patches) == fresh.get_text_with_formatting(["text"])
+
+
+def test_apply_changes_diverges_on_genuinely_missing_dep():
+    docs, _, _ = generate_docs("abc")
+    doc1, _ = docs
+    _c1, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 3, "values": ["d"]}])
+    c2, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 4, "values": ["e"]}])
+    fresh = Doc("fresh")
+    with pytest.raises(RuntimeError, match="did not converge"):
+        apply_changes(fresh, [c2])  # c1 and genesis withheld
+
+
+def test_causal_sort_orders_any_permutation():
+    import itertools
+    import random
+
+    docs, _, initial = generate_docs("ab")
+    doc1, doc2 = docs
+    c1, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 2, "values": ["c"]}])
+    doc2.apply_change(c1)
+    c2, _ = doc2.change([{"path": ["text"], "action": "insert", "index": 3, "values": ["d"]}])
+    doc1.apply_change(c2)
+    c3, _ = doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 1}])
+    batch = [initial, c1, c2, c3]
+    rng = random.Random(7)
+    for _ in range(10):
+        shuffled = list(batch)
+        rng.shuffle(shuffled)
+        ordered = causal_sort(shuffled)
+        fresh = Doc("x")
+        for change in ordered:  # must apply with zero retries
+            fresh.apply_change(change)
+        assert "".join(fresh.root["text"]) == "bcd"
+    with pytest.raises(ValueError, match="unsatisfiable"):
+        causal_sort([c2, c3])
+
+
+def test_pubsub_queue_editor_wiring_end_to_end():
+    """The bridge wiring pattern: editors publish batched changes, apply remote."""
+    docs, _, _ = generate_docs("hub", count=3)
+    pub = Publisher()
+    queues = {}
+    for doc in docs:
+        pub.subscribe(
+            doc.actor_id,
+            lambda changes, doc=doc: apply_changes(doc, list(changes)),
+        )
+        queues[doc.actor_id] = ChangeQueue(
+            handle_flush=lambda changes, actor=doc.actor_id: (
+                pub.publish(actor, changes) if changes else None
+            )
+        )
+    c, _ = docs[0].change([{"path": ["text"], "action": "insert", "index": 3, "values": ["!"]}])
+    queues["doc1"].enqueue(c)
+    c2, _ = docs[1].change([{"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 3, "markType": "em"}])
+    queues["doc2"].enqueue(c2)
+    for q in queues.values():
+        q.flush()
+    expected = docs[0].get_text_with_formatting(["text"])
+    assert all(d.get_text_with_formatting(["text"]) == expected for d in docs)
