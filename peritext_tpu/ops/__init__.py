@@ -1,0 +1,13 @@
+"""Tensorized document engine: dense SoA state + jitted CRDT kernels.
+
+This is the data plane of the framework.  Where the reference walks linked
+metadata with O(n) pointer-chasing scans per op (micromerge.ts:731-805,
+peritext.ts:168-214), this engine stores each replica as fixed-capacity
+struct-of-arrays tensors and applies operations with vectorized index
+arithmetic, masked shifts, bitset algebra, and prefix scans — `vmap`-able over
+thousands of replicas and shardable across TPU chips.
+"""
+from peritext_tpu.ops.state import DocState, make_empty_state
+from peritext_tpu.ops.universe import TpuUniverse
+
+__all__ = ["DocState", "make_empty_state", "TpuUniverse"]

@@ -1,0 +1,153 @@
+"""Host-side encoding: wire-format changes -> dense op tensors.
+
+The ChangeQueue analog at the host<->device boundary (SURVEY.md §2.4): a
+causally-sorted batch of changes is flattened into fixed-width int32 op rows
+(one row per *internal* op, kernels.py field layout), padded to a bucketed
+length so jit caches stay warm, and uploaded once per apply call.
+
+Actor strings and mark attrs are interned to dense ids here; the device only
+ever sees integers.  Map-object ops (makeList/makeMap/set/del on maps —
+structural control-plane ops, micromerge.ts:578-602) are split out for host
+handling: the device engine's data plane is the text list.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from peritext_tpu.ids import ActorRegistry, parse_op_id
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.schema import MARK_TYPE_ID
+
+
+class AttrRegistry:
+    """Interns mark attr dicts to dense ids (canonical-JSON keyed)."""
+
+    def __init__(self) -> None:
+        self._id_of: Dict[str, int] = {}
+        self._attrs: List[Dict[str, Any]] = []
+
+    def intern(self, attrs: Optional[Dict[str, Any]]) -> int:
+        if not attrs:
+            return -1
+        key = json.dumps(attrs, sort_keys=True)
+        i = self._id_of.get(key)
+        if i is None:
+            i = len(self._attrs)
+            self._id_of[key] = i
+            self._attrs.append(dict(attrs))
+        return i
+
+    def decode(self, i: int) -> Optional[Dict[str, Any]]:
+        if i < 0:
+            return None
+        return dict(self._attrs[i])
+
+
+_BOUNDARY_KIND = {"before": 0, "after": 1, "endOfText": 2}
+
+
+def encode_internal_op(
+    op: Dict[str, Any], actors: ActorRegistry, attrs: AttrRegistry
+) -> Optional[np.ndarray]:
+    """One wire-format internal op -> an int32 op row, or None for map ops."""
+    row = np.zeros(K.OP_FIELDS, np.int32)
+    ctr, actor = parse_op_id(op["opId"])
+    row[K.K_CTR] = ctr
+    row[K.K_ACT] = actors.intern(actor)
+    action = op["action"]
+
+    if action == "set" and op.get("insert"):
+        row[K.K_KIND] = K.KIND_INSERT
+        elem = op.get("elemId")
+        if elem is not None:
+            ref_ctr, ref_actor = parse_op_id(elem)
+            row[K.K_REF_CTR] = ref_ctr
+            row[K.K_REF_ACT] = actors.intern(ref_actor)
+        value = op["value"]
+        if not isinstance(value, str) or len(value) != 1:
+            raise ValueError(f"Expected 1-char string insert value, got {value!r}")
+        row[K.K_PAYLOAD] = ord(value)
+        return row
+
+    if action == "del" and op.get("elemId") is not None:
+        row[K.K_KIND] = K.KIND_DELETE
+        ref_ctr, ref_actor = parse_op_id(op["elemId"])
+        row[K.K_REF_CTR] = ref_ctr
+        row[K.K_REF_ACT] = actors.intern(ref_actor)
+        return row
+
+    if action in ("addMark", "removeMark"):
+        row[K.K_KIND] = K.KIND_MARK
+        row[K.K_MACTION] = 0 if action == "addMark" else 1
+        row[K.K_MTYPE] = MARK_TYPE_ID[op["markType"]]
+        row[K.K_MATTR] = attrs.intern(op.get("attrs"))
+        start, end = op["start"], op["end"]
+        if start["type"] not in ("before", "after"):
+            # startGrows is hardcoded false upstream (peritext.ts:466), so
+            # startOfText anchors cannot be produced by any writer.
+            raise NotImplementedError(f"start anchor {start['type']!r}")
+        row[K.K_SKIND] = _BOUNDARY_KIND[start["type"]]
+        sctr, sact = parse_op_id(start["elemId"])
+        row[K.K_SCTR] = sctr
+        row[K.K_SACT] = actors.intern(sact)
+        row[K.K_EKIND] = _BOUNDARY_KIND[end["type"]]
+        if end["type"] != "endOfText":
+            ectr, eact = parse_op_id(end["elemId"])
+            row[K.K_ECTR] = ectr
+            row[K.K_EACT] = actors.intern(eact)
+        return row
+
+    # Map-object / structural op: host concern.
+    return None
+
+
+def encode_changes(
+    changes: Sequence[Dict[str, Any]],
+    actors: ActorRegistry,
+    attrs: AttrRegistry,
+) -> Tuple[np.ndarray, List[Dict[str, Any]], Dict[str, int]]:
+    """Flatten a causally-ordered change batch into device op rows.
+
+    Returns (rows [N, OP_FIELDS], host_ops, counts) where host_ops are the
+    structural ops skipped for host handling and counts tallies inserts and
+    mark ops for capacity pre-checks.
+    """
+    rows: List[np.ndarray] = []
+    host_ops: List[Dict[str, Any]] = []
+    counts = {"insert": 0, "mark": 0}
+    for change in changes:
+        for op in change["ops"]:
+            row = encode_internal_op(op, actors, attrs)
+            if row is None:
+                host_ops.append(op)
+                continue
+            if row[K.K_KIND] == K.KIND_INSERT:
+                counts["insert"] += 1
+            elif row[K.K_KIND] == K.KIND_MARK:
+                counts["mark"] += 1
+            rows.append(row)
+    if rows:
+        out = np.stack(rows)
+    else:
+        out = np.zeros((0, K.OP_FIELDS), np.int32)
+    return out, host_ops, counts
+
+
+def pad_rows(rows: np.ndarray, length: int) -> np.ndarray:
+    """Pad op rows with KIND_PAD to a fixed length."""
+    if rows.shape[0] > length:
+        raise ValueError(f"op batch of {rows.shape[0]} exceeds pad length {length}")
+    out = np.zeros((length, K.OP_FIELDS), np.int32)
+    out[: rows.shape[0]] = rows
+    return out
+
+
+def bucket_length(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two so jit compilation caches stay warm."""
+    length = minimum
+    while length < n:
+        length *= 2
+    return length

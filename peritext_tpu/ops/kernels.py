@@ -1,0 +1,340 @@
+"""Jitted CRDT kernels over the dense DocState.
+
+Each of the reference's O(n) pointer-walk hot loops (SURVEY.md §3) becomes a
+vectorized tensor computation here:
+
+- ``findListElement`` / id lookup (micromerge.ts:731-755)  -> masked argmax
+- the concurrent-insert skip scan (micromerge.ts:630-635)  -> masked min over
+  a comparison vector (the skip run is contiguous, so its end is the first
+  non-skippable position)
+- metadata splice (micromerge.ts:638)                      -> masked shift
+- ``applyAddRemoveMark``'s 2n-position walk with carried op
+  sets (peritext.ts:154-223)                               -> prefix cummax
+  carry + bitset algebra over boundary-mask rows
+- ``getTextWithFormatting``'s left-inheritance walk
+  (peritext.ts:366-390)                                    -> segmented
+  carry via cummax over per-element boundary sources
+
+All kernels are pure ``DocState -> DocState`` functions of statically-shaped
+arrays: `jit`/`vmap`/`shard_map` compose over them, and `lax.scan` sequences
+ops within a causal batch while replicas stay embarrassingly parallel.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from peritext_tpu.ops.state import MASK_WORD_BITS, DocState
+from peritext_tpu.schema import ALLOW_MULTIPLE_BY_ID
+
+ALLOW_MULTIPLE_ARR = tuple(bool(x) for x in ALLOW_MULTIPLE_BY_ID)
+
+# Op-row field indices (see encode.py for the host-side encoder).
+K_KIND = 0  # 0 pad, 1 insert, 2 delete, 3 mark
+K_CTR = 1
+K_ACT = 2
+K_REF_CTR = 3  # insert: reference elem (0 = HEAD); delete: target elem
+K_REF_ACT = 4
+K_PAYLOAD = 5  # insert: codepoint
+K_MACTION = 6  # 0 addMark, 1 removeMark
+K_MTYPE = 7
+K_MATTR = 8
+K_SKIND = 9  # start boundary: 0 before, 1 after
+K_SCTR = 10
+K_SACT = 11
+K_EKIND = 12  # end boundary: 0 before, 1 after, 2 endOfText
+K_ECTR = 13
+K_EACT = 14
+OP_FIELDS = 15
+
+KIND_PAD = 0
+KIND_INSERT = 1
+KIND_DELETE = 2
+KIND_MARK = 3
+
+
+def _find_elem(state: DocState, ctr, act):
+    """Index of the element created by op (ctr@act); (C, found=False) if absent."""
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+    match = live & (state.elem_ctr == ctr) & (state.elem_act == act)
+    found = jnp.any(match)
+    return jnp.argmax(match).astype(jnp.int32), found
+
+
+def _apply_insert(state: DocState, op, ranks) -> DocState:
+    """RGA insert (reference micromerge.ts:614-672).
+
+    Position = after the reference element, then past the contiguous run of
+    elements whose ids exceed this op's id — the convergence rule for
+    concurrent same-position inserts (micromerge.ts:630-635).  The run is
+    contiguous by construction, so its end is the first position at or after
+    ref+1 that is dead or has a smaller id.
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+
+    is_head = (op[K_REF_CTR] == 0) & (op[K_REF_ACT] == 0)
+    ref_idx, _ = _find_elem(state, op[K_REF_CTR], op[K_REF_ACT])
+    idx = jnp.where(is_head, jnp.int32(-1), ref_idx)
+
+    op_rank = ranks[op[K_ACT]]
+    elem_rank = ranks[state.elem_act]
+    elem_gt_op = (state.elem_ctr > op[K_CTR]) | (
+        (state.elem_ctr == op[K_CTR]) & (elem_rank > op_rank)
+    )
+    stop = (ar > idx) & ~(live & elem_gt_op)
+    t = jnp.min(jnp.where(stop, ar, c)).astype(jnp.int32)
+
+    keep = ar < t
+    here = ar == t
+
+    def splice(arr, value):
+        return jnp.where(keep, arr, jnp.where(here, value, jnp.roll(arr, 1)))
+
+    slot_ar = jnp.arange(2 * c, dtype=jnp.int32)
+    slot_keep = slot_ar < 2 * t
+    slot_new = (slot_ar == 2 * t) | (slot_ar == 2 * t + 1)
+    bnd_def = jnp.where(slot_keep, state.bnd_def, jnp.where(slot_new, False, jnp.roll(state.bnd_def, 2)))
+    bnd_mask = jnp.where(
+        slot_keep[:, None],
+        state.bnd_mask,
+        jnp.where(slot_new[:, None], jnp.uint32(0), jnp.roll(state.bnd_mask, 2, axis=0)),
+    )
+
+    return DocState(
+        elem_ctr=splice(state.elem_ctr, op[K_CTR]),
+        elem_act=splice(state.elem_act, op[K_ACT]),
+        deleted=splice(state.deleted, False),
+        chars=splice(state.chars, op[K_PAYLOAD]),
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=state.mark_ctr,
+        mark_act=state.mark_act,
+        mark_action=state.mark_action,
+        mark_type=state.mark_type,
+        mark_attr=state.mark_attr,
+        length=state.length + 1,
+        mark_count=state.mark_count,
+    )
+
+
+def _apply_delete(state: DocState, op, ranks) -> DocState:
+    """Tombstone the target element (reference micromerge.ts:677-724).
+
+    Idempotent: re-deleting is a no-op, matching applyListUpdate's
+    already-deleted guard (micromerge.ts:689).
+    """
+    del ranks
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+    match = live & (state.elem_ctr == op[K_REF_CTR]) & (state.elem_act == op[K_REF_ACT])
+    return dataclasses.replace(state, deleted=state.deleted | match)
+
+
+def _apply_mark(state: DocState, op, ranks) -> DocState:
+    """Write a mark op into the boundary bitsets (reference peritext.ts:154-223).
+
+    Vectorized form of the BEFORE/DURING/AFTER walk.  Derivation (preserving
+    a reference subtlety): the walk's carried ``currentOps`` is never updated
+    with the op being applied (peritext.ts:181-186), so every write stores
+    ``carry_old | op_bit`` for slots in [start, end) and plain ``carry_old``
+    at the end slot, where ``carry_old[p]`` is the nearest *pre-op* defined
+    set at or left of p.  Written slots: the start slot, every already-defined
+    slot strictly inside the range, and the end slot.  If the end slot
+    precedes the start slot in walk order, the walk hits AFTER first and only
+    the end slot is written (with its carry), the op lands nowhere.
+    """
+    del ranks
+    c = state.capacity
+    big = jnp.int32(2 * c + 2)
+
+    s_idx, _ = _find_elem(state, op[K_SCTR], op[K_SACT])
+    s_slot = 2 * s_idx + op[K_SKIND]
+    e_idx, _ = _find_elem(state, op[K_ECTR], op[K_EACT])
+    e_slot = jnp.where(op[K_EKIND] == 2, big, 2 * e_idx + jnp.minimum(op[K_EKIND], 1))
+
+    slots = jnp.arange(2 * c, dtype=jnp.int32)
+    slot_live = slots < 2 * state.length
+    defined = state.bnd_def & slot_live
+
+    # carry_old[p]: nearest defined slot at or left of p (pre-op state).
+    src = lax.cummax(jnp.where(defined, slots, jnp.int32(-1)))
+    carry = jnp.where(
+        (src >= 0)[:, None], state.bnd_mask[jnp.maximum(src, 0)], jnp.uint32(0)
+    )
+
+    m = state.mark_count
+    word = m // MASK_WORD_BITS
+    bit = jnp.uint32(1) << (m % MASK_WORD_BITS).astype(jnp.uint32)
+    op_bit_row = jnp.zeros_like(state.bnd_mask[0]).at[word].set(bit)
+
+    s_lt_e = s_slot < e_slot
+    in_range = (slots >= s_slot) & (slots < e_slot) & s_lt_e
+    write = (in_range & ((slots == s_slot) | defined)) | (slots == e_slot)
+
+    new_rows = carry | jnp.where(in_range[:, None], op_bit_row, jnp.uint32(0))
+    bnd_mask = jnp.where(write[:, None], new_rows, state.bnd_mask)
+    bnd_def = state.bnd_def | write
+
+    return DocState(
+        elem_ctr=state.elem_ctr,
+        elem_act=state.elem_act,
+        deleted=state.deleted,
+        chars=state.chars,
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=state.mark_ctr.at[m].set(op[K_CTR]),
+        mark_act=state.mark_act.at[m].set(op[K_ACT]),
+        mark_action=state.mark_action.at[m].set(op[K_MACTION]),
+        mark_type=state.mark_type.at[m].set(op[K_MTYPE]),
+        mark_attr=state.mark_attr.at[m].set(op[K_MATTR]),
+        length=state.length,
+        mark_count=m + 1,
+    )
+
+
+def apply_op(state: DocState, op: jax.Array, ranks: jax.Array) -> DocState:
+    """Apply one encoded internal op.  ``op`` is an OP_FIELDS int32 row."""
+    kind = jnp.clip(op[K_KIND], 0, 3)
+    return lax.switch(
+        kind,
+        [
+            lambda s, o, r: s,  # pad
+            _apply_insert,
+            _apply_delete,
+            _apply_mark,
+        ],
+        state,
+        op,
+        ranks,
+    )
+
+
+def apply_ops(state: DocState, ops: jax.Array, ranks: jax.Array) -> DocState:
+    """Sequence a causally-ordered op batch with lax.scan.
+
+    Within one replica ops are sequentially dependent (an insert's position
+    depends on prior inserts — SURVEY.md §7 "hard parts"); across replicas
+    this function vmaps, which is the throughput axis.
+    """
+
+    def step(s, op):
+        return apply_op(s, op, ranks), None
+
+    final, _ = lax.scan(step, state, ops)
+    return final
+
+
+apply_ops_jit = jax.jit(apply_ops)
+apply_ops_batch = jax.jit(jax.vmap(apply_ops, in_axes=(0, 0, None)))
+
+
+def flatten_sources(state: DocState):
+    """Per-element effective boundary bitset, for materialization.
+
+    Tensorized getTextWithFormatting left-inheritance (peritext.ts:366-390):
+    element i's marks change at its "before" slot if defined, else at the
+    previous element's "after" slot; otherwise they carry from the left.
+    Returns (mask [C, W], has_marks [C]): the resolved mark-op bitset per
+    element (zeros/False where no boundary is in scope).
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+    before_def = state.bnd_def[0::2]
+    after_def = state.bnd_def[1::2]
+    prev_after_def = jnp.roll(after_def, 1) & (ar > 0)
+    d_slot = jnp.where(
+        before_def, 2 * ar, jnp.where(prev_after_def, 2 * ar - 1, jnp.int32(-1))
+    )
+    has = (d_slot >= 0) & live
+    src_elem = lax.cummax(jnp.where(has, ar, jnp.int32(-1)))
+    src_slot = jnp.where(src_elem >= 0, d_slot[jnp.maximum(src_elem, 0)], jnp.int32(-1))
+    mask = jnp.where(
+        (src_slot >= 0)[:, None], state.bnd_mask[jnp.maximum(src_slot, 0)], jnp.uint32(0)
+    )
+    return mask, src_slot >= 0
+
+
+flatten_sources_jit = jax.jit(flatten_sources)
+
+
+def expand_mask_bits(mask: jax.Array, max_mark_ops: int) -> jax.Array:
+    """[*, W] uint32 bitset rows -> [*, M] bool membership matrix."""
+    m_idx = jnp.arange(max_mark_ops, dtype=jnp.int32)
+    words = mask[..., m_idx // MASK_WORD_BITS]
+    return ((words >> (m_idx % MASK_WORD_BITS).astype(jnp.uint32)) & 1).astype(bool)
+
+
+def resolve_winners(state: DocState, present: jax.Array, ranks: jax.Array) -> jax.Array:
+    """LWW/multiset resolution of mark-op sets (reference opsToMarks,
+    peritext.ts:294-326), as a dominance matmul.
+
+    ``present[e, m]`` says mark op m is in element e's effective boundary set.
+    Op m is *dominated* by m' when both address the same resolution group —
+    same mark type for LWW marks, same (type, attr) for allowMultiple marks
+    (comments resolve per comment id) — and m' has the greater op id.  The
+    winners at an element are the present ops with no present dominator:
+    a [C, M] x [M, M] masked matmul, which XLA maps onto the MXU.
+
+    Returns winners [C, M] bool.  Effective marks follow directly: a winner
+    with action addMark activates (type, attrs); a removeMark winner means
+    the mark is absent.
+    """
+    is_multi = jnp.asarray(ALLOW_MULTIPLE_ARR)[state.mark_type]
+    same_type = state.mark_type[:, None] == state.mark_type[None, :]
+    same_attr = state.mark_attr[:, None] == state.mark_attr[None, :]
+    same_group = same_type & (~is_multi[:, None] | same_attr)
+    rank = ranks[state.mark_act]
+    key_gt = (state.mark_ctr[None, :] > state.mark_ctr[:, None]) | (
+        (state.mark_ctr[None, :] == state.mark_ctr[:, None])
+        & (rank[None, :] > rank[:, None])
+    )
+    m_live = jnp.arange(state.max_mark_ops, dtype=jnp.int32) < state.mark_count
+    dom = same_group & key_gt & m_live[None, :]  # dom[m, m']: m' dominates m
+    dom_count = jnp.einsum(
+        "em,nm->en", present.astype(jnp.float32), dom.astype(jnp.float32)
+    )
+    return present & (dom_count < 0.5) & m_live[None, :]
+
+
+def convergence_digest(state: DocState, ranks: jax.Array) -> jax.Array:
+    """Order-sensitive checksum of the visible document + resolved marks.
+
+    The TPU-native analog of the fuzzer's cross-replica convergence asserts
+    (fuzz.ts:277-278): replicas that converged have equal digests, so a batch
+    of replica pairs is convergence-checked with one vectorized compare (and
+    across shards with a collective reduce).  Hashes *resolved* mark content
+    (type/action/attr of winner ops), never table indices or bitset layout,
+    because convergent replicas may hold the same ops at different table
+    slots.
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = (ar < state.length) & ~state.deleted
+    liveu = live.astype(jnp.uint32)
+    vis_rank = (jnp.cumsum(liveu) - liveu) * liveu  # 0-based visible index
+    mask, _ = flatten_sources(state)
+    present = expand_mask_bits(mask, state.max_mark_ops)
+    winners = resolve_winners(state, present, ranks)
+    adds = winners & (state.mark_action[None, :] == 0)
+    mark_value = (
+        state.mark_type.astype(jnp.uint32) * jnp.uint32(1000003)
+        + (state.mark_attr + 1).astype(jnp.uint32) * jnp.uint32(8191)
+        + jnp.uint32(17)
+    )
+    char_mix = jnp.sum((state.chars.astype(jnp.uint32) * jnp.uint32(2654435761) + vis_rank) * liveu)
+    mark_mix = jnp.sum(
+        adds.astype(jnp.uint32) * mark_value[None, :] * (vis_rank[:, None] * jnp.uint32(31) + 7) * liveu[:, None]
+    )
+    return jnp.uint32(2166136261) ^ char_mix ^ (mark_mix * jnp.uint32(31))
+
+
+convergence_digest_batch = jax.jit(jax.vmap(convergence_digest, in_axes=(0, None)))

@@ -1,0 +1,140 @@
+"""Dense per-replica document state (struct-of-arrays, fixed capacity).
+
+The tensorization of the reference's metadata representation
+(micromerge.ts:237-253 ListItemMetadata + peritext.ts boundary sets):
+
+- RGA elements live in document order in parallel arrays ``elem_ctr`` /
+  ``elem_act`` (the op id that created each element, split into its counter
+  and an interned actor id), ``deleted`` (tombstone mask) and ``chars``
+  (codepoints).  Characters stay *aligned with metadata slots* — tombstones
+  keep their codepoint — so no separate visible-index bookkeeping is needed;
+  the visible text is ``chars[~deleted]``.
+- The 2C boundary gap positions (slot ``2i`` = before element i, ``2i+1`` =
+  after element i; peritext.ts:13-21) each hold a *bitset* over the
+  replica's mark-operation table instead of a ``Set<MarkOperation>``:
+  ``bnd_mask[p]`` is a width-W row of uint32 words, bit m <=> mark op m is in
+  the set.  ``bnd_def[p]`` distinguishes "no boundary here" (inherit from the
+  left) from an explicit — possibly empty — set, the distinction peritext.ts
+  encodes as undefined-vs-Set (peritext.ts:183, 372-376).
+- The mark-op table stores each applied addMark/removeMark op's
+  (counter, actor, action, markType, interned attrs).  Set resolution
+  (opsToMarks, peritext.ts:294-326) becomes masked max-reductions over this
+  table keyed by (counter, actor-rank).
+
+Capacities are static (XLA shapes): C elements, M mark ops, A actors.
+Overflow is a host-visible condition handled by re-bucketing into a larger
+state (see ``grow_state``), never silent truncation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MASK_WORD_BITS = 32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DocState:
+    # RGA element arrays [C]
+    elem_ctr: jax.Array  # int32; 0 in dead slots
+    elem_act: jax.Array  # int32 interned actor ids
+    deleted: jax.Array  # bool
+    chars: jax.Array  # int32 codepoints (kept for tombstones too)
+    # Boundary bitsets: [2C] definedness, [2C, W] uint32 set words
+    bnd_def: jax.Array
+    bnd_mask: jax.Array
+    # Mark-op table [M]
+    mark_ctr: jax.Array
+    mark_act: jax.Array
+    mark_action: jax.Array  # 0 = addMark, 1 = removeMark
+    mark_type: jax.Array  # schema MARK_TYPE_ID
+    mark_attr: jax.Array  # interned attr id, -1 = none
+    # Scalars
+    length: jax.Array  # live element count (int32)
+    mark_count: jax.Array  # live mark-op count (int32)
+
+    @property
+    def capacity(self) -> int:
+        return self.elem_ctr.shape[-1]
+
+    @property
+    def max_mark_ops(self) -> int:
+        return self.mark_ctr.shape[-1]
+
+
+def make_empty_state(capacity: int = 1024, max_mark_ops: int = 128) -> DocState:
+    if max_mark_ops % MASK_WORD_BITS != 0:
+        raise ValueError("max_mark_ops must be a multiple of 32")
+    words = max_mark_ops // MASK_WORD_BITS
+    return DocState(
+        elem_ctr=jnp.zeros(capacity, jnp.int32),
+        elem_act=jnp.zeros(capacity, jnp.int32),
+        deleted=jnp.zeros(capacity, bool),
+        chars=jnp.zeros(capacity, jnp.int32),
+        bnd_def=jnp.zeros(2 * capacity, bool),
+        bnd_mask=jnp.zeros((2 * capacity, words), jnp.uint32),
+        mark_ctr=jnp.zeros(max_mark_ops, jnp.int32),
+        mark_act=jnp.zeros(max_mark_ops, jnp.int32),
+        mark_action=jnp.zeros(max_mark_ops, jnp.int32),
+        mark_type=jnp.zeros(max_mark_ops, jnp.int32),
+        mark_attr=jnp.full(max_mark_ops, -1, jnp.int32),
+        length=jnp.zeros((), jnp.int32),
+        mark_count=jnp.zeros((), jnp.int32),
+    )
+
+
+def stack_states(states: list[DocState]) -> DocState:
+    """Stack replica states into one batched [R, ...] pytree."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def index_state(batched: DocState, r: int) -> DocState:
+    return jax.tree.map(lambda x: x[r], batched)
+
+
+def grow_state(state: DocState, capacity: int | None = None, max_mark_ops: int | None = None) -> DocState:
+    """Re-bucket a state into larger static capacities (host-side, rare)."""
+    old_c = state.capacity
+    old_m = state.max_mark_ops
+    new_c = capacity or old_c
+    new_m = max_mark_ops or old_m
+    if new_c < old_c or new_m < old_m:
+        raise ValueError("grow_state cannot shrink capacities")
+    if new_m % MASK_WORD_BITS != 0:
+        raise ValueError("max_mark_ops must be a multiple of 32")
+
+    def pad_to(x: Any, size: int, axis: int = -1, fill=0):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (0, size - x.shape[axis])
+        return jnp.pad(x, pad, constant_values=fill)
+
+    return DocState(
+        elem_ctr=pad_to(state.elem_ctr, new_c),
+        elem_act=pad_to(state.elem_act, new_c),
+        deleted=pad_to(state.deleted, new_c),
+        chars=pad_to(state.chars, new_c),
+        bnd_def=pad_to(state.bnd_def, 2 * new_c),
+        bnd_mask=pad_to(
+            pad_to(state.bnd_mask, 2 * new_c, axis=0), new_m // MASK_WORD_BITS, axis=1
+        ),
+        mark_ctr=pad_to(state.mark_ctr, new_m),
+        mark_act=pad_to(state.mark_act, new_m),
+        mark_action=pad_to(state.mark_action, new_m),
+        mark_type=pad_to(state.mark_type, new_m),
+        mark_attr=pad_to(state.mark_attr, new_m, fill=-1),
+        length=state.length,
+        mark_count=state.mark_count,
+    )
+
+
+def visible_text(state: DocState) -> str:
+    """Decode the visible document text (host)."""
+    chars = np.asarray(state.chars)
+    deleted = np.asarray(state.deleted)
+    n = int(state.length)
+    return "".join(chr(c) for c, d in zip(chars[:n], deleted[:n]) if not d)

@@ -1,0 +1,253 @@
+"""TpuUniverse: a batch of document replicas resident on device.
+
+The deployment unit of the TPU engine.  A universe holds R replica states
+stacked into one [R, ...] pytree, shares actor/attr interning across the
+batch, and ingests causally-gated change batches with a single
+jit(vmap(scan)) launch — the reference's applyChange path
+(micromerge.ts:499-514) batched over replicas, which is the framework's
+throughput axis (BASELINE.json north star).
+
+Host responsibilities (the control plane): causal sorting and the
+seq/deps gate per replica, wire-op encoding/interning, capacity pre-checks
+with automatic re-bucketing, and span decoding for materialization.  Device
+responsibilities (the data plane): all per-op document mutation, boundary-set
+algebra, mark resolution, digests.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from peritext_tpu.ids import ActorRegistry, make_op_id
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.encode import AttrRegistry, bucket_length, encode_changes, pad_rows
+from peritext_tpu.ops.state import (
+    DocState,
+    grow_state,
+    index_state,
+    make_empty_state,
+    stack_states,
+)
+from peritext_tpu.oracle.doc import add_characters_to_spans, ops_to_marks
+from peritext_tpu.runtime.sync import causal_sort
+from peritext_tpu.schema import ALL_MARKS
+
+Change = Dict[str, Any]
+
+
+class TpuUniverse:
+    def __init__(
+        self,
+        replica_ids: Sequence[str],
+        capacity: int = 256,
+        max_mark_ops: int = 64,
+        max_actors: int = 64,
+    ) -> None:
+        self.replica_ids = list(replica_ids)
+        self.index_of = {r: i for i, r in enumerate(self.replica_ids)}
+        self.actors = ActorRegistry()
+        self.attrs = AttrRegistry()
+        self.max_actors = max_actors
+        self.capacity = capacity
+        self.max_mark_ops = max_mark_ops
+        self.states: DocState = stack_states(
+            [make_empty_state(capacity, max_mark_ops) for _ in self.replica_ids]
+        )
+        # Host control-plane mirrors (never require device sync).
+        self.clocks: List[Dict[str, int]] = [dict() for _ in self.replica_ids]
+        self.lengths = [0] * len(self.replica_ids)
+        self.mark_counts = [0] * len(self.replica_ids)
+        self.roots: List[Dict[str, Any]] = [dict() for _ in self.replica_ids]
+
+    # -- capacity management ------------------------------------------------
+
+    def _ensure_capacity(self, need_len: int, need_marks: int) -> None:
+        new_c, new_m = self.capacity, self.max_mark_ops
+        while need_len > new_c:
+            new_c *= 2
+        while need_marks > new_m:
+            new_m *= 2
+        if (new_c, new_m) != (self.capacity, self.max_mark_ops):
+            states = [
+                grow_state(index_state(self.states, i), new_c, new_m)
+                for i in range(len(self.replica_ids))
+            ]
+            self.states = stack_states(states)
+            self.capacity, self.max_mark_ops = new_c, new_m
+
+    def _ranks(self) -> np.ndarray:
+        ranks = self.actors.ranks()
+        n = self.max_actors
+        while len(ranks) > n:
+            n *= 2
+        self.max_actors = n
+        out = np.zeros(n, np.int32)
+        out[: len(ranks)] = ranks
+        return out
+
+    # -- the causal gate (host) --------------------------------------------
+
+    def _gate(self, r: int, changes: Sequence[Change]) -> List[Change]:
+        """Order + validate a change batch against replica r's clock.
+
+        Single-pass equivalent of the reference's applyChange seq/deps gate
+        (micromerge.ts:501-509) + the retry loop (test/merge.ts:4-23):
+        causal_sort guarantees each change lands with its deps satisfied or
+        raises.  Duplicate (already-seen) changes are dropped idempotently.
+        """
+        clock = self.clocks[r]
+        seen = set()
+        fresh = []
+        for c in changes:
+            key = (c["actor"], c["seq"])
+            if c["seq"] > clock.get(c["actor"], 0) and key not in seen:
+                seen.add(key)
+                fresh.append(c)
+        ordered = causal_sort(fresh, clock)
+        for change in ordered:
+            clock[change["actor"]] = change["seq"]
+        return ordered
+
+    # -- ingestion ----------------------------------------------------------
+
+    def apply_changes(self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]) -> None:
+        """Apply a batch of changes to each named replica in one device launch."""
+        if isinstance(per_replica, dict):
+            batches: List[Sequence[Change]] = [[] for _ in self.replica_ids]
+            for name, changes in per_replica.items():
+                batches[self.index_of[name]] = changes
+        else:
+            batches = list(per_replica)
+            if len(batches) != len(self.replica_ids):
+                raise ValueError("need one change list per replica")
+
+        encoded: List[np.ndarray] = []
+        max_rows = 0
+        for r, changes in enumerate(batches):
+            ordered = self._gate(r, changes)
+            rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
+            self._apply_host_ops(r, host_ops)
+            self.lengths[r] += counts["insert"]
+            self.mark_counts[r] += counts["mark"]
+            encoded.append(rows)
+            max_rows = max(max_rows, rows.shape[0])
+
+        self._ensure_capacity(max(self.lengths, default=0), max(self.mark_counts, default=0))
+        if max_rows == 0:
+            return
+        pad = bucket_length(max_rows)
+        ops = np.stack([pad_rows(rows, pad) for rows in encoded])
+        ranks = self._ranks()
+        self.states = K.apply_ops_batch(self.states, jax.numpy.asarray(ops), jax.numpy.asarray(ranks))
+
+    def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:
+        """Structural map ops (makeList/makeMap/set/del on the root map).
+
+        The device data plane is the text list; the tiny root-map control
+        plane lives here.  Only the conventional single text list is
+        supported as a list target (reference demos/tests only ever create
+        root.text, bridge.ts:24-27).
+        """
+        root = self.roots[r]
+        for op in host_ops:
+            action = op["action"]
+            key = op.get("key")
+            if action == "makeList":
+                root.setdefault("__lists__", {})[key] = op["opId"]
+            elif action == "makeMap":
+                root.setdefault("__maps__", {})[key] = op["opId"]
+            elif action == "set":
+                root[key] = op.get("value")
+            elif action == "del":
+                root.pop(key, None)
+
+    # -- materialization ----------------------------------------------------
+
+    def _mark_op_table(self, state: DocState) -> Dict[str, Dict[str, Any]]:
+        n = int(state.mark_count)
+        ctr = np.asarray(state.mark_ctr[:n])
+        act = np.asarray(state.mark_act[:n])
+        action = np.asarray(state.mark_action[:n])
+        mtype = np.asarray(state.mark_type[:n])
+        attr = np.asarray(state.mark_attr[:n])
+        table: Dict[str, Dict[str, Any]] = {}
+        for m in range(n):
+            op_id = make_op_id(int(ctr[m]), self.actors.actor(int(act[m])))
+            op: Dict[str, Any] = {
+                "opId": op_id,
+                "action": "addMark" if action[m] == 0 else "removeMark",
+                "markType": ALL_MARKS[int(mtype[m])],
+            }
+            attrs = self.attrs.decode(int(attr[m]))
+            if attrs is not None:
+                op["attrs"] = attrs
+            table[op_id] = op
+        return table
+
+    def spans(self, replica: str | int) -> List[Dict[str, Any]]:
+        """Materialize one replica as formatted spans (the batch codepath).
+
+        Boundary resolution happens on device (flatten_sources); bitset
+        decoding and opsToMarks run on host over the (deduped) distinct mask
+        rows, sharing the oracle's resolution code so both engines agree by
+        construction.
+        """
+        r = replica if isinstance(replica, int) else self.index_of[replica]
+        state = index_state(self.states, r)
+        mask, has = K.flatten_sources_jit(state)
+        n = int(state.length)
+        mask_np = np.asarray(mask[:n])
+        has_np = np.asarray(has[:n])
+        deleted = np.asarray(state.deleted[:n])
+        chars = np.asarray(state.chars[:n])
+        table = self._mark_op_table(state)
+        op_ids = list(table)
+
+        def decode_row(row: np.ndarray) -> frozenset:
+            out = []
+            for m, op_id in enumerate(op_ids):
+                if row[m // 32] >> (m % 32) & 1:
+                    out.append(op_id)
+            return frozenset(out)
+
+        mark_cache: Dict[Any, Dict[str, Any]] = {}
+        spans: List[Dict[str, Any]] = []
+        characters: List[str] = []
+        marks: Dict[str, Any] = {}
+        prev_key: Any = None
+        for i in range(n):
+            key = (bool(has_np[i]), tuple(mask_np[i].tolist()))
+            if key != prev_key:
+                if key[0]:
+                    if key not in mark_cache:
+                        mark_cache[key] = ops_to_marks(decode_row(mask_np[i]), table)
+                    new_marks = mark_cache[key]
+                else:
+                    new_marks = {}
+                add_characters_to_spans(characters, marks, spans)
+                characters = []
+                marks = new_marks
+                prev_key = key
+            if not deleted[i]:
+                characters.append(chr(int(chars[i])))
+        add_characters_to_spans(characters, marks, spans)
+        return spans
+
+    def text(self, replica: str | int) -> str:
+        r = replica if isinstance(replica, int) else self.index_of[replica]
+        state = index_state(self.states, r)
+        n = int(state.length)
+        chars = np.asarray(state.chars[:n])
+        deleted = np.asarray(state.deleted[:n])
+        return "".join(chr(int(c)) for c, d in zip(chars, deleted) if not d)
+
+    def digests(self) -> np.ndarray:
+        """Per-replica convergence digests in one batched device call."""
+        ranks = jax.numpy.asarray(self._ranks())
+        return np.asarray(K.convergence_digest_batch(self.states, ranks))
+
+    def clock(self, replica: str | int) -> Dict[str, int]:
+        r = replica if isinstance(replica, int) else self.index_of[replica]
+        return dict(self.clocks[r])

@@ -1,0 +1,236 @@
+"""Differential tests: the TPU engine must agree with the oracle exactly.
+
+The same golden scenarios from test_oracle_examples.py run through
+TpuUniverse, and randomized change streams are cross-checked span-for-span.
+"""
+import random
+
+import pytest
+
+from peritext_tpu.fuzz import _random_add_mark, _random_delete, _random_insert, _random_remove_mark
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.oracle import Doc
+from peritext_tpu.runtime import ChangeLog
+from peritext_tpu.testing import generate_docs
+
+B = {"active": True}
+
+
+def run_concurrent_on_engine(
+    *, initial_text="The Peritext editor", pre_ops=None, input_ops1=(), input_ops2=()
+):
+    """The testConcurrentWrites harness, with TpuUniverse replicas ingesting
+    every change stream the oracle replicas generate."""
+    docs, _, initial_change = generate_docs(initial_text)
+    doc1, doc2 = docs
+    uni = TpuUniverse(["doc1", "doc2"])
+    uni.apply_changes({"doc1": [initial_change], "doc2": [initial_change]})
+
+    def with_path(ops):
+        return [{**op, "path": ["text"]} for op in ops]
+
+    changes = []
+    if pre_ops:
+        change0, _ = doc1.change(with_path(pre_ops))
+        doc2.apply_change(change0)
+        uni.apply_changes({"doc1": [change0], "doc2": [change0]})
+    change1, _ = doc1.change(with_path(input_ops1))
+    change2, _ = doc2.change(with_path(input_ops2))
+    doc2.apply_change(change1)
+    doc1.apply_change(change2)
+    uni.apply_changes({"doc1": [change1, change2], "doc2": [change2, change1]})
+
+    for name, doc in (("doc1", doc1), ("doc2", doc2)):
+        oracle_spans = doc.get_text_with_formatting(["text"])
+        engine_spans = uni.spans(name)
+        assert engine_spans == oracle_spans, (
+            f"{name}: engine {engine_spans} != oracle {oracle_spans}"
+        )
+    digests = uni.digests()
+    assert digests[0] == digests[1]
+    return uni
+
+
+SCENARIOS = {
+    "plain_merge": dict(
+        initial_text="abrxabra",
+        input_ops1=[
+            {"action": "delete", "index": 3, "count": 1},
+            {"action": "insert", "index": 4, "values": ["c", "a"]},
+        ],
+        input_ops2=[{"action": "insert", "index": 5, "values": ["d", "a"]}],
+    ),
+    "overlapping_bold_italic": dict(
+        input_ops1=[{"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"}],
+        input_ops2=[{"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}],
+    ),
+    "insert_end_plus_mark_to_end": dict(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 19, "values": list(" is great!")},
+        ],
+        input_ops2=[{"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}],
+    ),
+    "bold_vs_unbold": dict(
+        input_ops1=[{"action": "addMark", "startIndex": 0, "endIndex": 19, "markType": "strong"}],
+        input_ops2=[{"action": "removeMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}],
+    ),
+    "zero_width_span": dict(
+        pre_ops=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "delete", "index": 4, "count": 8},
+        ],
+        input_ops1=[{"action": "insert", "index": 4, "values": ["x"]}],
+    ),
+    "bold_grows_right": dict(
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+    ),
+    "link_does_not_grow": dict(
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+    ),
+    "tombstone_boundary_growth": dict(
+        initial_text="ABCDE",
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 1,
+                "endIndex": 4,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "delete", "index": 1, "count": 1},
+            {"action": "delete", "index": 2, "count": 1},
+            {"action": "insert", "index": 2, "values": ["F"]},
+        ],
+    ),
+    "concurrent_insert_at_mark_boundary": dict(
+        input_ops1=[{"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}],
+        input_ops2=[
+            {"action": "insert", "index": 4, "values": ["*"]},
+            {"action": "insert", "index": 13, "values": ["*"]},
+        ],
+    ),
+    "deleted_span_mark_insertion": dict(
+        pre_ops=[{"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}],
+        input_ops1=[{"action": "delete", "index": 4, "count": 8}],
+        input_ops2=[
+            {"action": "delete", "index": 5, "count": 3},
+            {"action": "insert", "index": 5, "values": list("ara")},
+        ],
+    ),
+    "link_lww_partial_overlap": dict(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+    ),
+    "overlapping_comments": dict(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "comment",
+                "attrs": {"id": "def-789"},
+            }
+        ],
+    ),
+    "adjacent_bold_unbold": dict(
+        initial_text="ABCDE",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 5, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 1, "endIndex": 4, "markType": "strong"},
+            {"action": "insert", "index": 1, "values": ["F"]},
+            {"action": "insert", "index": 5, "values": ["G"]},
+        ],
+    ),
+}
+
+
+@pytest.mark.parametrize("name", list(SCENARIOS))
+def test_engine_matches_oracle(name):
+    run_concurrent_on_engine(**SCENARIOS[name])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_engine_random_differential(seed):
+    """Randomized op streams: oracle replicas generate, both engines ingest."""
+    rng = random.Random(seed)
+    docs, _, initial_change = generate_docs("ABCDE", 3)
+    names = [d.actor_id for d in docs]
+    uni = TpuUniverse(names)
+    uni.apply_changes({n: [initial_change] for n in names})
+    log = ChangeLog()
+    log.record(initial_change)
+    comment_history = []
+
+    for step in range(40):
+        target = rng.randrange(len(docs))
+        doc = docs[target]
+        kind = rng.choice(["insert", "remove", "addMark", "removeMark"])
+        if kind == "insert":
+            op = _random_insert(rng, doc, 3)
+        elif kind == "remove":
+            op = _random_delete(rng, doc)
+        elif kind == "addMark":
+            op = _random_add_mark(rng, doc, comment_history)
+        else:
+            op = _random_remove_mark(rng, doc, comment_history, False)
+        if op is None:
+            continue
+        change, _ = doc.change([op])
+        log.record(change)
+        # Deliver to every other oracle replica and every engine replica.
+        batches = {}
+        for other in docs:
+            if other.actor_id != doc.actor_id:
+                for missing in log.missing_changes(doc.clock, other.clock):
+                    other.apply_change(missing)
+        for name in names:
+            batches[name] = log.missing_changes(log.clock(), uni.clock(name))
+        uni.apply_changes(batches)
+
+        if step % 10 == 9:
+            for name, oracle_doc in zip(names, docs):
+                assert uni.spans(name) == oracle_doc.get_text_with_formatting(["text"]), (
+                    f"seed {seed} step {step} replica {name}"
+                )
+    for name, oracle_doc in zip(names, docs):
+        assert uni.spans(name) == oracle_doc.get_text_with_formatting(["text"])
+    digests = uni.digests()
+    assert len(set(digests.tolist())) == 1
