@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""Headline benchmark: batched replica merge throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.json config 4 shape): R replicas, each holding a
+1k-char doc, each ingesting a concurrent op stream of inserts/deletes/marks
+(the applyChange merge path).  value = internal CRDT ops merged per second
+across the batch.  vs_baseline = speedup over the scalar exact-semantics
+engine (the stand-in for the reference TypeScript implementation, which
+publishes no numbers; BASELINE.md).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    num_replicas = int(os.environ.get("BENCH_REPLICAS", "1024"))
+    doc_len = int(os.environ.get("BENCH_DOC_LEN", "1000"))
+    ops_per_merge = int(os.environ.get("BENCH_OPS", "64"))
+
+    from peritext_tpu.bench.workloads import time_batched_merge, time_scalar_baseline
+
+    tpu = time_batched_merge(
+        num_replicas=num_replicas, doc_len=doc_len, ops_per_merge=ops_per_merge
+    )
+    scalar = time_scalar_baseline(doc_len=doc_len, ops_per_merge=ops_per_merge)
+
+    result = {
+        "metric": "merged_crdt_ops_per_sec_batched_replicas",
+        "value": round(tpu["ops_per_sec"], 1),
+        "unit": "ops/s",
+        "vs_baseline": round(tpu["ops_per_sec"] / scalar["ops_per_sec"], 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -136,6 +136,17 @@ def encode_changes(
     return out, host_ops, counts
 
 
+def split_rows(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Split encoded op rows into (text ops, mark ops), each in causal order.
+
+    Feeds the two-phase fast merge path (kernels.merge_step); see the
+    state-equivalence argument there for why the split preserves semantics.
+    """
+    kinds = rows[:, K.K_KIND]
+    is_mark = kinds == K.KIND_MARK
+    return rows[~is_mark], rows[is_mark]
+
+
 def pad_rows(rows: np.ndarray, length: int) -> np.ndarray:
     """Pad op rows with KIND_PAD to a fixed length."""
     if rows.shape[0] > length:

@@ -66,33 +66,40 @@ def _find_elem(state: DocState, ctr, act):
     return jnp.argmax(match).astype(jnp.int32), found
 
 
-def _apply_insert(state: DocState, op, ranks) -> DocState:
-    """RGA insert (reference micromerge.ts:614-672).
+def _rga_insert_position(elem_ctr, elem_act, length, op, ranks):
+    """RGA insert position (reference micromerge.ts:614-635).
 
     Position = after the reference element, then past the contiguous run of
     elements whose ids exceed this op's id — the convergence rule for
     concurrent same-position inserts (micromerge.ts:630-635).  The run is
     contiguous by construction, so its end is the first position at or after
-    ref+1 that is dead or has a smaller id.
+    ref+1 that is dead or has a smaller id.  Shared by the faithful per-op
+    path and the fast two-phase path so their tie-breaks can never diverge.
+    Returns (t, keep, here) masks for masked-shift splicing.
     """
-    c = state.capacity
+    c = elem_ctr.shape[0]
     ar = jnp.arange(c, dtype=jnp.int32)
-    live = ar < state.length
+    live = ar < length
 
     is_head = (op[K_REF_CTR] == 0) & (op[K_REF_ACT] == 0)
-    ref_idx, _ = _find_elem(state, op[K_REF_CTR], op[K_REF_ACT])
-    idx = jnp.where(is_head, jnp.int32(-1), ref_idx)
+    match = live & (elem_ctr == op[K_REF_CTR]) & (elem_act == op[K_REF_ACT])
+    idx = jnp.where(is_head, jnp.int32(-1), jnp.argmax(match).astype(jnp.int32))
 
     op_rank = ranks[op[K_ACT]]
-    elem_rank = ranks[state.elem_act]
-    elem_gt_op = (state.elem_ctr > op[K_CTR]) | (
-        (state.elem_ctr == op[K_CTR]) & (elem_rank > op_rank)
+    elem_gt_op = (elem_ctr > op[K_CTR]) | (
+        (elem_ctr == op[K_CTR]) & (ranks[elem_act] > op_rank)
     )
     stop = (ar > idx) & ~(live & elem_gt_op)
     t = jnp.min(jnp.where(stop, ar, c)).astype(jnp.int32)
+    return t, ar < t, ar == t
 
-    keep = ar < t
-    here = ar == t
+
+def _apply_insert(state: DocState, op, ranks) -> DocState:
+    """RGA insert (reference micromerge.ts:614-672)."""
+    c = state.capacity
+    t, keep, here = _rga_insert_position(
+        state.elem_ctr, state.elem_act, state.length, op, ranks
+    )
 
     def splice(arr, value):
         return jnp.where(keep, arr, jnp.where(here, value, jnp.roll(arr, 1)))
@@ -233,7 +240,205 @@ def apply_ops(state: DocState, ops: jax.Array, ranks: jax.Array) -> DocState:
 
 
 apply_ops_jit = jax.jit(apply_ops)
-apply_ops_batch = jax.jit(jax.vmap(apply_ops, in_axes=(0, 0, None)))
+apply_ops_vmapped = jax.vmap(apply_ops, in_axes=(0, 0, None))
+apply_ops_batch = jax.jit(apply_ops_vmapped)
+
+
+# ---------------------------------------------------------------------------
+# Fast merge path: kind-split two-phase application
+# ---------------------------------------------------------------------------
+#
+# State-equivalence argument for reordering a causally-sorted op batch into
+# (all inserts+deletes, in order) followed by (all mark ops, in order):
+# a mark op writes only boundary sets, whose contents are keyed by stable
+# element identity; an insert splices *undefined* boundary slots, so it
+# neither reads nor changes any defined set, and a delete only flips a
+# tombstone flag that mark application ignores for state purposes (the
+# visible index matters only for patch emission, which this path does not
+# do).  Hence mark<->text adjacent transpositions preserve the final state,
+# and the two-phase order is reachable by such transpositions while keeping
+# each kind's internal order.  Patch-faithful application uses the
+# interleaved apply_ops path instead.
+
+
+def _apply_text_op(carry, op, ranks):
+    """Insert/delete on the reduced text state (no boundary tables).
+
+    carry = (elem_ctr, elem_act, deleted, chars, orig_idx, length).
+    ``orig_idx`` tags each element with its pre-batch position (-1 for
+    elements inserted by this batch) so the boundary tables can be permuted
+    once at the end of the phase instead of shifted per insert.
+    """
+    elem_ctr, elem_act, deleted, chars, orig_idx, length = carry
+    ar = jnp.arange(elem_ctr.shape[0], dtype=jnp.int32)
+    live = ar < length
+    is_insert = op[K_KIND] == KIND_INSERT
+    is_delete = op[K_KIND] == KIND_DELETE
+
+    # Delete: tombstone the match.
+    match = live & (elem_ctr == op[K_REF_CTR]) & (elem_act == op[K_REF_ACT])
+    deleted_after_del = deleted | (match & is_delete)
+
+    # Insert: shared position rule, then masked-shift splice.
+    _, keep, here = _rga_insert_position(elem_ctr, elem_act, length, op, ranks)
+
+    def splice(arr, value):
+        return jnp.where(keep, arr, jnp.where(here, value, jnp.roll(arr, 1)))
+
+    new_carry = (
+        jnp.where(is_insert, splice(elem_ctr, op[K_CTR]), elem_ctr),
+        jnp.where(is_insert, splice(elem_act, op[K_ACT]), elem_act),
+        jnp.where(is_insert, splice(deleted_after_del, False), deleted_after_del),
+        jnp.where(is_insert, splice(chars, op[K_PAYLOAD]), chars),
+        jnp.where(is_insert, splice(orig_idx, jnp.int32(-1)), orig_idx),
+        length + is_insert.astype(jnp.int32),
+    )
+    return new_carry, None
+
+
+def _permute_boundaries(bnd_def, bnd_mask, orig_idx):
+    """Re-align boundary tables after a text phase, in one gather."""
+    c = orig_idx.shape[0]
+    valid = orig_idx >= 0
+    safe = jnp.maximum(orig_idx, 0)
+    def2 = bnd_def.reshape(c, 2)
+    mask2 = bnd_mask.reshape(c, 2, -1)
+    new_def = jnp.where(valid[:, None], def2[safe], False).reshape(2 * c)
+    new_mask = jnp.where(valid[:, None, None], mask2[safe], jnp.uint32(0)).reshape(
+        2 * c, -1
+    )
+    return new_def, new_mask
+
+
+def _apply_mark_fast(carry, op, elem_ctr, elem_act, length):
+    """Mark application without patches, cummax, or full-width gathers.
+
+    Only three kinds of slots are written (see _apply_mark's derivation):
+    already-defined slots inside [start, end) OR in their own op bit (their
+    carry is their own row); the start slot takes (nearest defined row at or
+    left of it) | bit; the end slot takes its carry row unchanged.  The two
+    carry lookups are single dynamic row reads.
+    """
+    bnd_def, bnd_mask, mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count = carry
+    c = elem_ctr.shape[0]
+    is_mark = op[K_KIND] == KIND_MARK
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < length
+    big = jnp.int32(2 * c + 2)
+
+    s_match = live & (elem_ctr == op[K_SCTR]) & (elem_act == op[K_SACT])
+    s_slot = 2 * jnp.argmax(s_match).astype(jnp.int32) + op[K_SKIND]
+    e_match = live & (elem_ctr == op[K_ECTR]) & (elem_act == op[K_EACT])
+    e_slot = jnp.where(
+        op[K_EKIND] == 2,
+        big,
+        2 * jnp.argmax(e_match).astype(jnp.int32) + jnp.minimum(op[K_EKIND], 1),
+    )
+
+    slots = jnp.arange(2 * c, dtype=jnp.int32)
+    defined = bnd_def & (slots < 2 * length)
+
+    def carry_row_at(p):
+        src = jnp.max(jnp.where(defined & (slots <= p), slots, jnp.int32(-1)))
+        row = lax.dynamic_slice_in_dim(bnd_mask, jnp.maximum(src, 0), 1, axis=0)[0]
+        return jnp.where(src >= 0, row, jnp.uint32(0))
+
+    m = mark_count
+    bit = jnp.uint32(1) << (m % MASK_WORD_BITS).astype(jnp.uint32)
+    op_bit_row = jnp.zeros_like(bnd_mask[0]).at[m // MASK_WORD_BITS].set(bit)
+
+    s_lt_e = s_slot < e_slot
+    in_range = (slots >= s_slot) & (slots < e_slot) & s_lt_e & is_mark
+
+    # Defined slots inside the range OR in the op bit.
+    new_mask = jnp.where(
+        (in_range & defined)[:, None], bnd_mask | op_bit_row[None, :], bnd_mask
+    )
+    # Start slot: carry | bit (single row update).
+    row_s = (carry_row_at(s_slot) | op_bit_row)[None, :]
+    write_s = is_mark & s_lt_e
+    new_mask = jnp.where(
+        write_s,
+        lax.dynamic_update_slice_in_dim(new_mask, row_s, s_slot, axis=0),
+        new_mask,
+    )
+    # End slot: plain carry row (no bit).  Skipped for endOfText.
+    e_clamped = jnp.minimum(e_slot, jnp.int32(2 * c - 1))
+    write_e = is_mark & (e_slot < 2 * c)
+    row_e = carry_row_at(e_clamped)[None, :]
+    new_mask = jnp.where(
+        write_e,
+        lax.dynamic_update_slice_in_dim(new_mask, row_e, e_clamped, axis=0),
+        new_mask,
+    )
+    new_def = bnd_def | (in_range & defined) | ((slots == s_slot) & write_s) | (
+        (slots == e_slot) & write_e
+    )
+
+    new_carry = (
+        new_def,
+        new_mask,
+        jnp.where(is_mark, mark_ctr.at[m].set(op[K_CTR]), mark_ctr),
+        jnp.where(is_mark, mark_act.at[m].set(op[K_ACT]), mark_act),
+        jnp.where(is_mark, mark_action.at[m].set(op[K_MACTION]), mark_action),
+        jnp.where(is_mark, mark_type.at[m].set(op[K_MTYPE]), mark_type),
+        jnp.where(is_mark, mark_attr.at[m].set(op[K_MATTR]), mark_attr),
+        m + is_mark.astype(jnp.int32),
+    )
+    return new_carry, None
+
+
+def merge_step(state: DocState, text_ops: jax.Array, mark_ops: jax.Array, ranks: jax.Array) -> DocState:
+    """Fast batched merge: text phase -> boundary permute -> mark phase.
+
+    The production remote-ingestion path (no patch emission).  ``text_ops``
+    holds the batch's inserts/deletes in causal order, ``mark_ops`` its mark
+    ops in causal order; both padded with KIND_PAD rows.
+    """
+    c = state.capacity
+    orig_idx = jnp.arange(c, dtype=jnp.int32)
+
+    text_carry = (state.elem_ctr, state.elem_act, state.deleted, state.chars, orig_idx, state.length)
+    (elem_ctr, elem_act, deleted, chars, orig_idx, length), _ = lax.scan(
+        lambda cry, op: _apply_text_op(cry, op, ranks), text_carry, text_ops
+    )
+    bnd_def, bnd_mask = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+
+    mark_carry = (
+        bnd_def,
+        bnd_mask,
+        state.mark_ctr,
+        state.mark_act,
+        state.mark_action,
+        state.mark_type,
+        state.mark_attr,
+        state.mark_count,
+    )
+    (bnd_def, bnd_mask, mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count), _ = lax.scan(
+        lambda cry, op: _apply_mark_fast(cry, op, elem_ctr, elem_act, length),
+        mark_carry,
+        mark_ops,
+    )
+
+    return DocState(
+        elem_ctr=elem_ctr,
+        elem_act=elem_act,
+        deleted=deleted,
+        chars=chars,
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=mark_ctr,
+        mark_act=mark_act,
+        mark_action=mark_action,
+        mark_type=mark_type,
+        mark_attr=mark_attr,
+        length=length,
+        mark_count=mark_count,
+    )
+
+
+merge_step_vmapped = jax.vmap(merge_step, in_axes=(0, 0, 0, None))
+merge_step_batch = jax.jit(merge_step_vmapped)
 
 
 def flatten_sources(state: DocState):

@@ -22,7 +22,13 @@ import numpy as np
 
 from peritext_tpu.ids import ActorRegistry, make_op_id
 from peritext_tpu.ops import kernels as K
-from peritext_tpu.ops.encode import AttrRegistry, bucket_length, encode_changes, pad_rows
+from peritext_tpu.ops.encode import (
+    AttrRegistry,
+    bucket_length,
+    encode_changes,
+    pad_rows,
+    split_rows,
+)
 from peritext_tpu.ops.state import (
     DocState,
     grow_state,
@@ -123,24 +129,35 @@ class TpuUniverse:
             if len(batches) != len(self.replica_ids):
                 raise ValueError("need one change list per replica")
 
-        encoded: List[np.ndarray] = []
-        max_rows = 0
+        text_batches: List[np.ndarray] = []
+        mark_batches: List[np.ndarray] = []
+        max_text = max_mark = 0
         for r, changes in enumerate(batches):
             ordered = self._gate(r, changes)
             rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
             self._apply_host_ops(r, host_ops)
             self.lengths[r] += counts["insert"]
             self.mark_counts[r] += counts["mark"]
-            encoded.append(rows)
-            max_rows = max(max_rows, rows.shape[0])
+            text_rows, mark_rows = split_rows(rows)
+            text_batches.append(text_rows)
+            mark_batches.append(mark_rows)
+            max_text = max(max_text, text_rows.shape[0])
+            max_mark = max(max_mark, mark_rows.shape[0])
 
         self._ensure_capacity(max(self.lengths, default=0), max(self.mark_counts, default=0))
-        if max_rows == 0:
+        if max_text == 0 and max_mark == 0:
             return
-        pad = bucket_length(max_rows)
-        ops = np.stack([pad_rows(rows, pad) for rows in encoded])
+        text_pad = bucket_length(max(max_text, 1))
+        mark_pad = bucket_length(max(max_mark, 1))
+        text_ops = np.stack([pad_rows(rows, text_pad) for rows in text_batches])
+        mark_ops = np.stack([pad_rows(rows, mark_pad) for rows in mark_batches])
         ranks = self._ranks()
-        self.states = K.apply_ops_batch(self.states, jax.numpy.asarray(ops), jax.numpy.asarray(ranks))
+        self.states = K.merge_step_batch(
+            self.states,
+            jax.numpy.asarray(text_ops),
+            jax.numpy.asarray(mark_ops),
+            jax.numpy.asarray(ranks),
+        )
 
     def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:
         """Structural map ops (makeList/makeMap/set/del on the root map).

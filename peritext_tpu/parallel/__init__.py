@@ -1,0 +1,25 @@
+"""Multi-chip scaling: replica-batch + sequence sharding over device meshes.
+
+The reference's "distributed backend" is an in-process Publisher + vector
+clock anti-entropy (SURVEY.md §2.4); at TPU scale the replica batch is the
+parallel axis.  A universe's [R, ...] state shards across a
+``jax.sharding.Mesh`` with the replica dimension as data parallelism and the
+sequence (capacity) dimension optionally sharded for very long documents —
+XLA GSPMD inserts the ICI collectives (prefix-scan exchanges, argmax
+reductions) that the sequence-sharded kernels need.
+"""
+from peritext_tpu.parallel.mesh import (
+    make_mesh,
+    shard_states,
+    sharded_apply,
+    sharded_digest_reduce,
+    state_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_states",
+    "sharded_apply",
+    "sharded_digest_reduce",
+    "state_sharding",
+]

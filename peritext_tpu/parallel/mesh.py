@@ -1,0 +1,117 @@
+"""Device-mesh construction and sharding specs for batched replica state.
+
+Axes:
+- ``replica``: data parallelism over the replica batch (the throughput axis;
+  BASELINE.json configs 3-5).  Merges are replica-local, so this axis needs
+  no communication during op application.
+- ``seq``: optional sequence parallelism over the document capacity
+  dimension, for long documents.  The kernels are pure jnp index arithmetic
+  + prefix scans, so GSPMD shards them over ``seq`` by inserting ICI
+  collectives (segmented-scan carries, argmax all-reduces) automatically.
+
+Cross-replica reductions (convergence digests) ride ``psum``-style
+all-reduces over the mesh; across hosts the same program spans DCN via
+standard multi-host jax.distributed initialization.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.state import DocState
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    replica_axis: Optional[int] = None,
+    seq_axis: int = 1,
+) -> Mesh:
+    """Build a (replica, seq) mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if replica_axis is None:
+        replica_axis = n // seq_axis
+    if replica_axis * seq_axis != n:
+        raise ValueError(f"mesh {replica_axis}x{seq_axis} != {n} devices")
+    arr = np.array(devices).reshape(replica_axis, seq_axis)
+    return Mesh(arr, ("replica", "seq"))
+
+
+def state_sharding(mesh: Mesh, shard_seq: bool = True) -> DocState:
+    """A DocState-shaped pytree of NamedShardings for batched [R, ...] state.
+
+    The replica batch dim shards over ``replica``; the capacity dims (C and
+    2C) shard over ``seq`` when requested; the mark table replicates within a
+    replica shard (it is small and consulted by every sequence position).
+    """
+    seq = "seq" if shard_seq else None
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return DocState(
+        elem_ctr=ns("replica", seq),
+        elem_act=ns("replica", seq),
+        deleted=ns("replica", seq),
+        chars=ns("replica", seq),
+        bnd_def=ns("replica", seq),
+        bnd_mask=ns("replica", seq, None),
+        mark_ctr=ns("replica", None),
+        mark_act=ns("replica", None),
+        mark_action=ns("replica", None),
+        mark_type=ns("replica", None),
+        mark_attr=ns("replica", None),
+        length=ns("replica"),
+        mark_count=ns("replica"),
+    )
+
+
+def shard_states(states: DocState, mesh: Mesh, shard_seq: bool = True) -> DocState:
+    shardings = state_sharding(mesh, shard_seq)
+    return jax.tree.map(jax.device_put, states, shardings)
+
+
+def _apply_and_digest(states: DocState, text_ops: jax.Array, mark_ops: jax.Array, ranks: jax.Array):
+    """One full sharded step: batched fast merge + global convergence.
+
+    The jnp.sum over per-replica digests lowers to an all-reduce across the
+    ``replica`` mesh axis; the sequence-sharded kernels inside get their
+    carry/argmax collectives from GSPMD.
+    """
+    new_states = K.merge_step_vmapped(states, text_ops, mark_ops, ranks)
+    digests = jax.vmap(K.convergence_digest, in_axes=(0, None))(new_states, ranks)
+    global_digest = jnp.sum(digests)
+    return new_states, digests, global_digest
+
+
+def sharded_apply(mesh: Mesh, shard_seq: bool = True):
+    """jit-compile the full step with explicit mesh shardings."""
+    st_shard = state_sharding(mesh, shard_seq)
+    ops_shard = NamedSharding(mesh, P("replica", None, None))
+    ranks_shard = NamedSharding(mesh, P())
+    digest_shard = NamedSharding(mesh, P("replica"))
+    return jax.jit(
+        _apply_and_digest,
+        in_shardings=(st_shard, ops_shard, ops_shard, ranks_shard),
+        out_shardings=(st_shard, digest_shard, NamedSharding(mesh, P())),
+    )
+
+
+def sharded_digest_reduce(mesh: Mesh, shard_seq: bool = True):
+    """Batched digest computation + global reduce under mesh shardings."""
+    st_shard = state_sharding(mesh, shard_seq)
+
+    def f(states: DocState, ranks: jax.Array):
+        digests = jax.vmap(K.convergence_digest, in_axes=(0, None))(states, ranks)
+        return digests, jnp.sum(digests)
+
+    return jax.jit(
+        f,
+        in_shardings=(st_shard, NamedSharding(mesh, P())),
+        out_shardings=(NamedSharding(mesh, P("replica")), NamedSharding(mesh, P())),
+    )

@@ -2,11 +2,23 @@
 
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
 8-device CPU mesh (the driver separately dry-run-compiles the multi-chip path
-via __graft_entry__.dryrun_multichip).  Must run before any jax import.
+via __graft_entry__.dryrun_multichip).  Must run before any backend init.
+
+Two environment quirks this handles:
+- This image's sitecustomize registers the axon TPU backend and pins
+  ``jax_platforms="axon,cpu"`` at interpreter start, and the ambient env also
+  carries JAX_PLATFORMS=axon — neither reflects a developer's intent for the
+  *test suite*, so tests default to cpu regardless.
+- To deliberately run the suite against the real device, set
+  PERITEXT_TEST_PLATFORM=axon (or any platform name) explicitly.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_platform = os.environ.get("PERITEXT_TEST_PLATFORM", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
