@@ -1,0 +1,221 @@
+"""Editor-facing bridge: live editing sessions over the CRDT.
+
+The equivalent of the reference's ProseMirror bridge (bridge.ts:198-344)
+with the editor toolkit abstracted away: an :class:`Editor` wires a document
+replica to an outbound :class:`ChangeQueue` and a shared :class:`Publisher`,
+translates editor transactions into input operations
+(`applyProsemirrorTransactionToMicromergeDoc`, bridge.ts:417-539), and
+surfaces remote changes as incremental patches through a callback
+(`extendProsemirrorTransactionWithMicromergePatch`, bridge.ts:132-195 — here
+the callback consumes the framework's Patch dicts directly).
+
+Editor "steps" mirror ProseMirror's step vocabulary:
+- ``("replace", from_pos, to_pos, text)``  -> delete + insert input ops
+- ``("add_mark", from_pos, to_pos, mark_type, attrs)`` -> addMark
+- ``("remove_mark", from_pos, to_pos, mark_type, attrs)`` -> removeMark
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from peritext_tpu.oracle import Doc
+from peritext_tpu.runtime import ChangeQueue, Publisher
+from peritext_tpu.runtime.sync import apply_changes
+from peritext_tpu.schema import MARK_SPEC
+
+Patch = Dict[str, Any]
+Step = Tuple
+
+
+class Comment:
+    """Side-table entry for a comment body (reference comment.ts:1-12).
+
+    The document stores only mark ids; comment content lives beside it.
+    """
+
+    __slots__ = ("id", "actor", "content")
+
+    def __init__(self, comment_id: str, actor: str, content: str):
+        self.id = comment_id
+        self.actor = actor
+        self.content = content
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Comment({self.id!r}, {self.actor!r}, {self.content!r})"
+
+
+def initialize_docs(
+    docs: Sequence[Doc], initial_ops: Optional[Sequence[Dict[str, Any]]] = None
+) -> Dict[str, Any]:
+    """Bootstrap replicas from a single genesis change on docs[0].
+
+    Reference bridge.ts:106-120: all replicas share one makeList change so
+    root structure can never diverge.
+    """
+    ops: List[Dict[str, Any]] = [{"path": [], "action": "makeList", "key": "text"}]
+    if initial_ops:
+        ops.extend(initial_ops)
+    change, _ = docs[0].change(ops)
+    for doc in docs[1:]:
+        doc.apply_change(change)
+    return change
+
+
+class Editor:
+    """One user's live editing session (reference createEditor, bridge.ts:198).
+
+    Local steps -> input ops -> a local change (applied immediately, local
+    patches surfaced) -> enqueued for batched publish.  Remote changes arrive
+    via the publisher subscription, pass the causal gate, and surface as
+    patches through ``on_patch`` / ``on_remote_patch``.
+    """
+
+    def __init__(
+        self,
+        doc: Doc,
+        publisher: Publisher,
+        *,
+        interval: float = 0.01,
+        editable: bool = True,
+        on_patch: Optional[Callable[[Patch], None]] = None,
+        on_remote_patch: Optional[Callable[[Patch], None]] = None,
+    ) -> None:
+        self.doc = doc
+        self.publisher = publisher
+        self.editable = editable
+        self.on_patch = on_patch
+        self.on_remote_patch = on_remote_patch
+        self.comments: Dict[str, Comment] = {}
+        self.change_log: List[Dict[str, Any]] = []
+        self.queue = ChangeQueue(
+            handle_flush=self._publish_changes, interval=interval
+        )
+        publisher.subscribe(doc.actor_id, self._receive_changes)
+
+    # -- outbound ----------------------------------------------------------
+
+    def _publish_changes(self, changes: List[Dict[str, Any]]) -> None:
+        if changes:
+            self.publisher.publish(self.doc.actor_id, changes)
+
+    def apply_steps(self, steps: Sequence[Step]) -> List[Patch]:
+        """Translate editor steps into one transactional change."""
+        if not self.editable:
+            raise PermissionError("editor is read-only")
+        input_ops: List[Dict[str, Any]] = []
+        for step in steps:
+            input_ops.extend(self._step_to_ops(step))
+        if not input_ops:
+            return []
+        change, patches = self.doc.change(input_ops)
+        self.change_log.append(change)
+        self.queue.enqueue(change)
+        if self.on_patch:
+            for patch in patches:
+                self.on_patch(patch)
+        return patches
+
+    def _step_to_ops(self, step: Step) -> List[Dict[str, Any]]:
+        kind = step[0]
+        if kind == "replace":
+            _, from_pos, to_pos, text = step
+            ops: List[Dict[str, Any]] = []
+            if to_pos > from_pos:
+                ops.append(
+                    {"path": ["text"], "action": "delete", "index": from_pos, "count": to_pos - from_pos}
+                )
+            if text:
+                ops.append(
+                    {"path": ["text"], "action": "insert", "index": from_pos, "values": list(text)}
+                )
+            return ops
+        if kind in ("add_mark", "remove_mark"):
+            _, from_pos, to_pos, mark_type, *rest = step
+            attrs = rest[0] if rest else None
+            if MARK_SPEC[mark_type].attr_keys and kind == "add_mark" and not attrs:
+                raise ValueError(f"{mark_type} marks require attrs")
+            op = {
+                "path": ["text"],
+                "action": "addMark" if kind == "add_mark" else "removeMark",
+                "startIndex": from_pos,
+                "endIndex": to_pos,
+                "markType": mark_type,
+            }
+            if attrs:
+                op["attrs"] = dict(attrs)
+            return [op]
+        raise ValueError(f"Unknown step kind: {kind}")
+
+    # -- convenience commands (reference keymap, bridge.ts:35-68) -----------
+
+    def insert(self, index: int, text: str) -> List[Patch]:
+        return self.apply_steps([("replace", index, index, text)])
+
+    def delete(self, index: int, count: int) -> List[Patch]:
+        return self.apply_steps([("replace", index, index + count, "")])
+
+    def toggle_mark(self, from_pos: int, to_pos: int, mark_type: str) -> List[Patch]:
+        """Mod-B/Mod-I analog: add the boolean mark over the range."""
+        return self.apply_steps([("add_mark", from_pos, to_pos, mark_type)])
+
+    def add_comment(self, from_pos: int, to_pos: int, content: str) -> str:
+        """Mod-E analog: comment with a fresh id; body goes to the side table."""
+        comment_id = f"comment-{random.getrandbits(32):08x}"
+        self.comments[comment_id] = Comment(comment_id, self.doc.actor_id, content)
+        self.apply_steps([("add_mark", from_pos, to_pos, "comment", {"id": comment_id})])
+        return comment_id
+
+    def add_link(self, from_pos: int, to_pos: int, url: str) -> List[Patch]:
+        """Mod-K analog."""
+        return self.apply_steps([("add_mark", from_pos, to_pos, "link", {"url": url})])
+
+    # -- inbound -----------------------------------------------------------
+
+    def _receive_changes(self, changes: Sequence[Dict[str, Any]]) -> None:
+        patches = apply_changes(self.doc, list(changes))
+        for patch in patches:
+            if self.on_patch:
+                self.on_patch(patch)
+            if self.on_remote_patch:
+                self.on_remote_patch(patch)
+
+    # -- views ---------------------------------------------------------------
+
+    def spans(self) -> List[Dict[str, Any]]:
+        return self.doc.get_text_with_formatting(["text"])
+
+    def text(self) -> str:
+        return "".join(self.doc.root.get("text", []))
+
+    def sync(self) -> None:
+        """Manual flush (the demo Sync button, index.ts:124-128)."""
+        self.queue.flush()
+
+
+class EditorNetwork:
+    """A set of editors on one shared publisher (the live-demo topology)."""
+
+    def __init__(self, actor_ids: Sequence[str], initial_text: str = "", **editor_kwargs):
+        self.publisher: Publisher = Publisher()
+        docs = [Doc(actor) for actor in actor_ids]
+        initial_ops = (
+            [{"path": ["text"], "action": "insert", "index": 0, "values": list(initial_text)}]
+            if initial_text
+            else None
+        )
+        self.genesis = initialize_docs(docs, initial_ops)
+        self.editors: Dict[str, Editor] = {
+            doc.actor_id: Editor(doc, self.publisher, **editor_kwargs) for doc in docs
+        }
+
+    def __getitem__(self, actor_id: str) -> Editor:
+        return self.editors[actor_id]
+
+    def sync_all(self) -> None:
+        for editor in self.editors.values():
+            editor.sync()
+
+    def converged(self) -> bool:
+        spans = [e.spans() for e in self.editors.values()]
+        return all(s == spans[0] for s in spans[1:])

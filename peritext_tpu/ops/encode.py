@@ -45,6 +45,10 @@ class AttrRegistry:
             return None
         return dict(self._attrs[i])
 
+    @property
+    def values(self) -> List[Dict[str, Any]]:
+        return [dict(a) for a in self._attrs]
+
 
 _BOUNDARY_KIND = {"before": 0, "after": 1, "endOfText": 2}
 

@@ -471,6 +471,40 @@ def flatten_sources(state: DocState):
 flatten_sources_jit = jax.jit(flatten_sources)
 
 
+def cursor_elem(state: DocState, index: jax.Array):
+    """Element id (ctr, act) of the index-th visible element.
+
+    Tensorized getListElementId without the tombstone-peek option
+    (reference micromerge.ts:762-805; cursors use the plain form,
+    micromerge.ts:465-472).  Returns (ctr, act, found).
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    visible = (ar < state.length) & ~state.deleted
+    rank = jnp.cumsum(visible.astype(jnp.int32)) - 1  # visible index per slot
+    match = visible & (rank == index)
+    i = jnp.argmax(match).astype(jnp.int32)
+    return state.elem_ctr[i], state.elem_act[i], jnp.any(match)
+
+
+def resolve_cursor_index(state: DocState, ctr: jax.Array, act: jax.Array):
+    """Visible index of the element (ctr, act): count of visible elements
+    before it (reference findListElement, micromerge.ts:731-755 — a deleted
+    cursor target resolves to the position where it was)."""
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+    match = live & (state.elem_ctr == ctr) & (state.elem_act == act)
+    i = jnp.argmax(match).astype(jnp.int32)
+    visible = live & ~state.deleted
+    before = jnp.sum((ar < i) & visible).astype(jnp.int32)
+    return before, jnp.any(match)
+
+
+cursor_elem_jit = jax.jit(cursor_elem)
+resolve_cursor_index_jit = jax.jit(resolve_cursor_index)
+
+
 def expand_mask_bits(mask: jax.Array, max_mark_ops: int) -> jax.Array:
     """[*, W] uint32 bitset rows -> [*, M] bool membership matrix."""
     m_idx = jnp.arange(max_mark_ops, dtype=jnp.int32)

@@ -265,6 +265,35 @@ class TpuUniverse:
         ranks = jax.numpy.asarray(self._ranks())
         return np.asarray(K.convergence_digest_batch(self.states, ranks))
 
+    def get_cursor(self, replica: str | int, index: int) -> Dict[str, Any]:
+        """Stable cursor for a visible index (reference micromerge.ts:465-472)."""
+        r = replica if isinstance(replica, int) else self.index_of[replica]
+        state = index_state(self.states, r)
+        ctr, act, found = K.cursor_elem_jit(state, jax.numpy.int32(index))
+        if not bool(found):
+            raise IndexError(f"List index out of bounds: {index}")
+        return {
+            "objectId": self.roots[r].get("__lists__", {}).get("text"),
+            "elemId": make_op_id(int(ctr), self.actors.actor(int(act))),
+        }
+
+    def resolve_cursor(self, replica: str | int, cursor: Dict[str, Any]) -> int:
+        """Current visible index of a cursor (reference micromerge.ts:475-477)."""
+        from peritext_tpu.ids import parse_op_id
+
+        r = replica if isinstance(replica, int) else self.index_of[replica]
+        state = index_state(self.states, r)
+        ctr, actor = parse_op_id(cursor["elemId"])
+        if actor not in self.actors:
+            raise KeyError(f"List element not found: {cursor['elemId']}")
+        act = self.actors.id_of(actor)
+        index, found = K.resolve_cursor_index_jit(
+            state, jax.numpy.int32(ctr), jax.numpy.int32(act)
+        )
+        if not bool(found):
+            raise KeyError(f"List element not found: {cursor['elemId']}")
+        return int(index)
+
     def clock(self, replica: str | int) -> Dict[str, int]:
         r = replica if isinstance(replica, int) else self.index_of[replica]
         return dict(self.clocks[r])

@@ -1,0 +1,102 @@
+"""Checkpoint/resume: device-state snapshots + change-log tail replay.
+
+The reference's durability story is the append-only per-actor change log —
+any replica is reconstructible by replaying logs through applyChange (that
+is exactly how its failure-trace JSONs work, SURVEY.md §5).  This module
+keeps that model and adds the TPU-scale fast path: snapshot the dense device
+state (one npz of the stacked arrays + a JSON control-plane sidecar), then on
+resume replay only the log tail past the snapshot's vector clocks.
+
+Format:
+- ``<path>.npz``  — every DocState leaf, batched [R, ...]
+- ``<path>.json`` — replica ids, per-replica clocks/lengths/mark counts,
+  actor and attr intern tables, capacities, roots
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from peritext_tpu.ids import ActorRegistry
+from peritext_tpu.ops.encode import AttrRegistry
+from peritext_tpu.ops.state import DocState
+from peritext_tpu.ops.universe import TpuUniverse
+
+import dataclasses
+
+_STATE_FIELDS = [f.name for f in dataclasses.fields(DocState)]
+
+
+def save_universe(uni: TpuUniverse, path: str) -> None:
+    arrays = {f: np.asarray(getattr(uni.states, f)) for f in _STATE_FIELDS}
+    # Write both files atomically so a crash mid-save never destroys the
+    # previous good snapshot.
+    tmp_npz = path + ".npz.tmp"
+    with open(tmp_npz, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp_npz, path + ".npz")
+    sidecar = {
+        "replica_ids": uni.replica_ids,
+        "clocks": uni.clocks,
+        "lengths": uni.lengths,
+        "mark_counts": uni.mark_counts,
+        "roots": uni.roots,
+        "capacity": uni.capacity,
+        "max_mark_ops": uni.max_mark_ops,
+        "max_actors": uni.max_actors,
+        "actors": uni.actors.actors,
+        "attrs": uni.attrs.values,
+    }
+    tmp = path + ".json.tmp"
+    with open(tmp, "w") as f:
+        json.dump(sidecar, f)
+    os.replace(tmp, path + ".json")
+
+
+def load_universe(path: str) -> TpuUniverse:
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    uni = TpuUniverse(
+        sidecar["replica_ids"],
+        capacity=sidecar["capacity"],
+        max_mark_ops=sidecar["max_mark_ops"],
+        max_actors=sidecar["max_actors"],
+    )
+    uni.clocks = [dict(c) for c in sidecar["clocks"]]
+    uni.lengths = list(sidecar["lengths"])
+    uni.mark_counts = list(sidecar["mark_counts"])
+    uni.roots = [dict(r) for r in sidecar["roots"]]
+    actors = ActorRegistry()
+    for actor in sidecar["actors"]:
+        actors.intern(actor)
+    uni.actors = actors
+    attrs = AttrRegistry()
+    for attr in sidecar["attrs"]:
+        attrs.intern(attr)
+    uni.attrs = attrs
+
+    data = np.load(path + ".npz")
+    uni.states = DocState(**{f: jax.numpy.asarray(data[f]) for f in _STATE_FIELDS})
+    return uni
+
+
+def resume_universe(
+    path: str, log: Any, replicas: Optional[List[str]] = None
+) -> TpuUniverse:
+    """Load a snapshot and replay the change-log tail past its clocks.
+
+    ``log`` is a :class:`peritext_tpu.runtime.log.ChangeLog` (or anything
+    with ``missing_changes``).  Replicas named in the snapshot resume to the
+    log's frontier; this is the crash-recovery path.
+    """
+    uni = load_universe(path)
+    frontier = log.clock()
+    batches: Dict[str, List[Dict[str, Any]]] = {}
+    for name in replicas or uni.replica_ids:
+        batches[name] = log.missing_changes(frontier, uni.clock(name))
+    uni.apply_changes(batches)
+    return uni

@@ -1,0 +1,109 @@
+"""Editor bridge: live sessions, steps, commands, comments, patch callbacks."""
+import pytest
+
+from peritext_tpu.bridge import Editor, EditorNetwork, initialize_docs
+from peritext_tpu.oracle import Doc, accumulate_patches
+from peritext_tpu.runtime import Publisher
+
+B = {"active": True}
+
+
+def make_network(text="The Peritext editor", actors=("alice", "bob")):
+    return EditorNetwork(actors, initial_text=text)
+
+
+def test_live_demo_topology_two_editors():
+    net = make_network()
+    alice, bob = net["alice"], net["bob"]
+    alice.apply_steps([("add_mark", 4, 12, "strong")])
+    bob.insert(19, "!")
+    assert not net.converged()  # queued, not yet flushed (manual-sync mode)
+    net.sync_all()
+    assert net.converged()
+    spans = alice.spans()
+    assert spans == [
+        {"marks": {}, "text": "The "},
+        {"marks": {"strong": B}, "text": "Peritext"},
+        {"marks": {}, "text": " editor!"},
+    ]
+
+
+def test_replace_step_maps_to_delete_plus_insert():
+    net = make_network("hello world")
+    net["alice"].apply_steps([("replace", 0, 5, "goodbye")])
+    net.sync_all()
+    assert net["bob"].text() == "goodbye world"
+
+
+def test_patch_callbacks_reconstruct_document():
+    patches = {"alice": [], "bob": []}
+    pub = Publisher()
+    docs = [Doc("alice"), Doc("bob")]
+    initialize_docs(docs)
+    editors = {
+        d.actor_id: Editor(
+            d, pub, on_patch=lambda p, k=d.actor_id: patches[k].append(p)
+        )
+        for d in docs
+    }
+    # Patches from before editor construction: seed with current state.
+    for k, d in zip(patches, docs):
+        text = "".join(d.root.get("text", []))
+        if text:
+            patches[k].append(
+                {"path": ["text"], "action": "insert", "index": 0, "values": list(text), "marks": {}}
+            )
+
+    editors["alice"].insert(0, "Hi there")
+    editors["alice"].apply_steps([("add_mark", 0, 2, "em")])
+    editors["bob"].sync()
+    editors["alice"].sync()
+    # Incremental patch accumulation must equal both editors' batch views.
+    for k, e in editors.items():
+        assert accumulate_patches(patches[k]) == e.spans(), k
+    assert editors["alice"].spans() == editors["bob"].spans()
+
+
+def test_remote_patch_hook_fires_only_for_remote_changes():
+    remote = []
+    net = EditorNetwork(["a", "b"], initial_text="x")
+    net["b"].on_remote_patch = remote.append
+    net["a"].insert(1, "y")
+    assert remote == []
+    net.sync_all()
+    assert len(remote) == 1 and remote[0]["action"] == "insert"
+
+
+def test_comment_command_and_side_table():
+    net = make_network("review me")
+    cid = net["alice"].add_comment(0, 6, "typo here?")
+    net.sync_all()
+    spans = net["bob"].spans()
+    assert spans[0]["marks"] == {"comment": [{"id": cid}]}
+    assert net["alice"].comments[cid].content == "typo here?"
+    assert net["alice"].comments[cid].actor == "alice"
+
+
+def test_link_command_and_lww():
+    net = make_network("click here")
+    net["alice"].add_link(0, 5, "a.example")
+    net["bob"].add_link(0, 5, "b.example")
+    net.sync_all()
+    assert net.converged()
+    winner = net["alice"].spans()[0]["marks"]["link"]["url"]
+    assert winner in ("a.example", "b.example")
+
+
+def test_readonly_editor_rejects_steps():
+    pub = Publisher()
+    docs = [Doc("solo")]
+    initialize_docs(docs)
+    viewer = Editor(docs[0], pub, editable=False)
+    with pytest.raises(PermissionError):
+        viewer.insert(0, "nope")
+
+
+def test_comment_requires_attrs():
+    net = make_network()
+    with pytest.raises(ValueError, match="require attrs"):
+        net["alice"].apply_steps([("add_mark", 0, 3, "comment")])

@@ -1,0 +1,45 @@
+"""Engine cursors mirror the oracle's cursor semantics (micromerge.ts:1290-1417)."""
+import pytest
+
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.testing import generate_docs
+
+
+def build(text="The Peritext editor"):
+    docs, _, genesis = generate_docs(text)
+    uni = TpuUniverse(["doc1", "doc2"])
+    uni.apply_changes({"doc1": [genesis], "doc2": [genesis]})
+    return docs, uni
+
+
+def test_cursor_round_trip_and_stability():
+    docs, uni = build()
+    doc1 = docs[0]
+    cursor = uni.get_cursor("doc1", 5)
+    assert cursor["elemId"] == doc1.get_cursor(["text"], 5)["elemId"]
+    assert uni.resolve_cursor("doc1", cursor) == 5
+
+    change, _ = doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["a", "b", "c"]}]
+    )
+    uni.apply_changes({"doc1": [change], "doc2": [change]})
+    assert uni.resolve_cursor("doc1", cursor) == 8
+    assert doc1.resolve_cursor(cursor) == 8
+
+
+def test_cursor_collapses_when_prefix_deleted():
+    docs, uni = build()
+    doc1 = docs[0]
+    cursor = uni.get_cursor("doc1", 5)
+    change, _ = doc1.change(
+        [{"path": ["text"], "action": "delete", "index": 0, "count": 7}]
+    )
+    uni.apply_changes({"doc1": [change], "doc2": [change]})
+    assert uni.resolve_cursor("doc1", cursor) == 0
+    assert doc1.resolve_cursor(cursor) == 0
+
+
+def test_cursor_out_of_bounds():
+    _, uni = build("ab")
+    with pytest.raises(IndexError):
+        uni.get_cursor("doc1", 99)
