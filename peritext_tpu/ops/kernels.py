@@ -145,6 +145,37 @@ def _apply_delete(state: DocState, op, ranks) -> DocState:
     return dataclasses.replace(state, deleted=state.deleted | match)
 
 
+def _mark_slot_context(state: DocState, op):
+    """Shared boundary-slot context for mark application and patch signals.
+
+    Returns (s_slot, e_slot, slots, defined, carry) where carry[p] is the
+    nearest pre-op defined set at or left of p (the walk's currentOps,
+    peritext.ts:181-186).  Shared so the patch signals can never
+    desynchronize from the state the op actually writes.
+    """
+    c = state.capacity
+    big = jnp.int32(2 * c + 2)
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+
+    s_match = live & (state.elem_ctr == op[K_SCTR]) & (state.elem_act == op[K_SACT])
+    s_slot = 2 * jnp.argmax(s_match).astype(jnp.int32) + op[K_SKIND]
+    e_match = live & (state.elem_ctr == op[K_ECTR]) & (state.elem_act == op[K_EACT])
+    e_slot = jnp.where(
+        op[K_EKIND] == 2,
+        big,
+        2 * jnp.argmax(e_match).astype(jnp.int32) + jnp.minimum(op[K_EKIND], 1),
+    )
+
+    slots = jnp.arange(2 * c, dtype=jnp.int32)
+    defined = state.bnd_def & (slots < 2 * state.length)
+    src = lax.cummax(jnp.where(defined, slots, jnp.int32(-1)))
+    carry = jnp.where(
+        (src >= 0)[:, None], state.bnd_mask[jnp.maximum(src, 0)], jnp.uint32(0)
+    )
+    return s_slot, e_slot, slots, defined, carry
+
+
 def _apply_mark(state: DocState, op, ranks) -> DocState:
     """Write a mark op into the boundary bitsets (reference peritext.ts:154-223).
 
@@ -159,24 +190,7 @@ def _apply_mark(state: DocState, op, ranks) -> DocState:
     the end slot is written (with its carry), the op lands nowhere.
     """
     del ranks
-    c = state.capacity
-    big = jnp.int32(2 * c + 2)
-
-    s_idx, _ = _find_elem(state, op[K_SCTR], op[K_SACT])
-    s_slot = 2 * s_idx + op[K_SKIND]
-    e_idx, _ = _find_elem(state, op[K_ECTR], op[K_EACT])
-    e_slot = jnp.where(op[K_EKIND] == 2, big, 2 * e_idx + jnp.minimum(op[K_EKIND], 1))
-
-    slots = jnp.arange(2 * c, dtype=jnp.int32)
-    slot_live = slots < 2 * state.length
-    defined = state.bnd_def & slot_live
-
-    # carry_old[p]: nearest defined slot at or left of p (pre-op state).
-    src = lax.cummax(jnp.where(defined, slots, jnp.int32(-1)))
-    carry = jnp.where(
-        (src >= 0)[:, None], state.bnd_mask[jnp.maximum(src, 0)], jnp.uint32(0)
-    )
-
+    s_slot, e_slot, slots, defined, carry = _mark_slot_context(state, op)
     m = state.mark_count
     word = m // MASK_WORD_BITS
     bit = jnp.uint32(1) << (m % MASK_WORD_BITS).astype(jnp.uint32)
@@ -242,6 +256,146 @@ def apply_ops(state: DocState, ops: jax.Array, ranks: jax.Array) -> DocState:
 apply_ops_jit = jax.jit(apply_ops)
 apply_ops_vmapped = jax.vmap(apply_ops, in_axes=(0, 0, None))
 apply_ops_batch = jax.jit(apply_ops_vmapped)
+
+
+# ---------------------------------------------------------------------------
+# Patch-emitting faithful path (the incremental codepath on device)
+# ---------------------------------------------------------------------------
+
+
+def _mark_patch_signals(state: DocState, op, ranks):
+    """Per-slot patch signals for a mark op (reference peritext.ts:181-214).
+
+    Returns (written, during, changed, vis, final_vis):
+    - written[p]: the walk writes slot p (start slot, defined slots strictly
+      inside the range, end slot)
+    - during[p]: the DURING window [start, end)
+    - changed[p]: adding this op to slot p's inherited set changes the
+      *effective* marks there — the `opsToMarks(current) != opsToMarks(new)`
+      test, restricted to the op's own resolution group (its mark type, or
+      its (type, comment-id) group for allowMultiple marks), because adding
+      one op cannot change any other group's resolution
+    - vis[p]: the reference walk's visibleIndex at slot p's patch logic
+    - final_vis: total visible length (also objLength for patch clamping)
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+
+    s_slot, e_slot, slots, defined, carry = _mark_slot_context(state, op)
+    s_lt_e = s_slot < e_slot
+    during = (slots >= s_slot) & (slots < e_slot) & s_lt_e
+    written = (during & ((slots == s_slot) | defined)) | (slots == e_slot)
+
+    # visibleIndex per slot: before-slot of element i sees the count of
+    # visible elements before i; after-slot sees the count through i.
+    visible = live & ~state.deleted
+    vcum = jnp.cumsum(visible.astype(jnp.int32))
+    vis = jnp.stack([vcum - visible.astype(jnp.int32), vcum], axis=1).reshape(2 * c)
+    final_vis = vcum[c - 1] if c > 0 else jnp.int32(0)
+
+    # Inherited (pre-op) sets at every slot, as presence bits.
+    present = expand_mask_bits(carry, state.max_mark_ops)  # [2C, M]
+
+    # Winner of the op's own resolution group per slot.
+    m_live = jnp.arange(state.max_mark_ops, dtype=jnp.int32) < state.mark_count
+    is_multi = jnp.asarray(ALLOW_MULTIPLE_ARR)[op[K_MTYPE]]
+    group = m_live & (state.mark_type == op[K_MTYPE]) & (
+        ~is_multi | (state.mark_attr == op[K_MATTR])
+    )
+    cand = present & group[None, :]
+    # Two-pass lexicographic argmax on (ctr, rank) without int64:
+    rank = ranks[state.mark_act]
+    neg = jnp.int32(-(2**31) + 1)
+    ctrs = jnp.where(cand, state.mark_ctr[None, :], neg)
+    max_ctr = jnp.max(ctrs, axis=1)  # [2C]
+    tie = cand & (state.mark_ctr[None, :] == max_ctr[:, None])
+    rks = jnp.where(tie, rank[None, :], neg)
+    max_rank = jnp.max(rks, axis=1)
+    win = tie & (rank[None, :] == max_rank[:, None])  # one-hot winner per slot
+    has_winner = jnp.any(cand, axis=1)
+
+    w_action = jnp.sum(jnp.where(win, state.mark_action[None, :], 0), axis=1)
+    w_attr = jnp.sum(jnp.where(win, state.mark_attr[None, :], 0), axis=1)
+    w_ctr = jnp.where(has_winner, max_ctr, jnp.int32(-1))
+    w_rank = jnp.where(has_winner, max_rank, jnp.int32(-1))
+
+    op_rank = ranks[op[K_ACT]]
+    op_wins = ~has_winner | (op[K_CTR] > w_ctr) | (
+        (op[K_CTR] == w_ctr) & (op_rank > w_rank)
+    )
+    old_active = has_winner & (w_action == 0)
+    new_active = op[K_MACTION] == 0
+    value_differs = (old_active != new_active) | (
+        old_active & new_active & (w_attr != op[K_MATTR])
+    )
+    changed = op_wins & value_differs
+
+    return written, during, changed, vis, final_vis
+
+
+def apply_op_patched(state: DocState, op: jax.Array, ranks: jax.Array):
+    """Faithful per-op application + a fixed-shape patch record.
+
+    The record feeds host-side patch assembly (universe.assemble_patches),
+    which produces the exact reference Patch stream (micromerge.ts:25-30).
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+    visible = live & ~state.deleted
+    kind = jnp.clip(op[K_KIND], 0, 3)
+    is_insert = kind == KIND_INSERT
+    is_delete = kind == KIND_DELETE
+    is_mark = kind == KIND_MARK
+
+    # Insert: visible position + inherited marks (pre-insert closest defined
+    # boundary strictly left of the insertion gap; getActiveMarksAtIndex,
+    # peritext.ts:328-330).
+    t, _, _ = _rga_insert_position(state.elem_ctr, state.elem_act, state.length, op, ranks)
+    ins_index = jnp.sum(visible & (ar < t)).astype(jnp.int32)
+    slots = jnp.arange(2 * c, dtype=jnp.int32)
+    defined = state.bnd_def & (slots < 2 * state.length)
+    src_left = jnp.max(jnp.where(defined & (slots < 2 * t), slots, jnp.int32(-1)))
+    ins_mask = jnp.where(
+        src_left >= 0,
+        lax.dynamic_slice_in_dim(state.bnd_mask, jnp.maximum(src_left, 0), 1, axis=0)[0],
+        jnp.uint32(0),
+    )
+
+    # Delete: visible position of the target; valid only if not tombstoned.
+    d_match = live & (state.elem_ctr == op[K_REF_CTR]) & (state.elem_act == op[K_REF_ACT])
+    d_idx = jnp.argmax(d_match).astype(jnp.int32)
+    del_valid = jnp.any(d_match) & ~state.deleted[d_idx]
+    del_index = jnp.sum(visible & (ar < d_idx)).astype(jnp.int32)
+
+    written, during, changed, vis, final_vis = _mark_patch_signals(state, op, ranks)
+
+    record = {
+        "kind": kind,
+        "index": jnp.where(is_insert, ins_index, del_index),
+        "valid": is_insert | (is_delete & del_valid) | is_mark,
+        "char": op[K_PAYLOAD],
+        "obj_len": final_vis,
+        "ins_mask": ins_mask,
+        "written": written & is_mark,
+        "during": during & is_mark,
+        "changed": changed & is_mark,
+        "vis": vis,
+    }
+    new_state = apply_op(state, op, ranks)
+    return new_state, record
+
+
+def apply_ops_patched(state: DocState, ops: jax.Array, ranks: jax.Array):
+    def step(s, op):
+        return apply_op_patched(s, op, ranks)
+
+    return lax.scan(step, state, ops)
+
+
+apply_ops_patched_jit = jax.jit(apply_ops_patched)
+apply_ops_patched_batch = jax.jit(jax.vmap(apply_ops_patched, in_axes=(0, 0, None)))
 
 
 # ---------------------------------------------------------------------------

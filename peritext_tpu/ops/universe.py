@@ -37,7 +37,7 @@ from peritext_tpu.ops.state import (
     stack_states,
 )
 from peritext_tpu.oracle.doc import add_characters_to_spans, ops_to_marks
-from peritext_tpu.runtime.sync import causal_sort
+from peritext_tpu.runtime.sync import causal_order
 from peritext_tpu.schema import ALL_MARKS
 
 Change = Dict[str, Any]
@@ -99,9 +99,11 @@ class TpuUniverse:
         """Order + validate a change batch against replica r's clock.
 
         Single-pass equivalent of the reference's applyChange seq/deps gate
-        (micromerge.ts:501-509) + the retry loop (test/merge.ts:4-23):
-        causal_sort guarantees each change lands with its deps satisfied or
-        raises.  Duplicate (already-seen) changes are dropped idempotently.
+        (micromerge.ts:501-509) + the retry loop (test/merge.ts:4-23).
+        Delivery order is preserved among causally-ready changes
+        (causal_order), because patch streams are order-sensitive and must
+        match what an incremental replica consuming the same delivery order
+        would emit.  Duplicate (already-seen) changes drop idempotently.
         """
         clock = self.clocks[r]
         seen = set()
@@ -111,23 +113,29 @@ class TpuUniverse:
             if c["seq"] > clock.get(c["actor"], 0) and key not in seen:
                 seen.add(key)
                 fresh.append(c)
-        ordered = causal_sort(fresh, clock)
+        ordered = causal_order(fresh, clock)
         for change in ordered:
             clock[change["actor"]] = change["seq"]
         return ordered
 
     # -- ingestion ----------------------------------------------------------
 
-    def apply_changes(self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]) -> None:
-        """Apply a batch of changes to each named replica in one device launch."""
+    def _normalize_batches(
+        self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]
+    ) -> List[Sequence[Change]]:
         if isinstance(per_replica, dict):
             batches: List[Sequence[Change]] = [[] for _ in self.replica_ids]
             for name, changes in per_replica.items():
                 batches[self.index_of[name]] = changes
-        else:
-            batches = list(per_replica)
-            if len(batches) != len(self.replica_ids):
-                raise ValueError("need one change list per replica")
+            return batches
+        batches = list(per_replica)
+        if len(batches) != len(self.replica_ids):
+            raise ValueError("need one change list per replica")
+        return batches
+
+    def apply_changes(self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]) -> None:
+        """Apply a batch of changes to each named replica in one device launch."""
+        batches = self._normalize_batches(per_replica)
 
         text_batches: List[np.ndarray] = []
         mark_batches: List[np.ndarray] = []
@@ -179,6 +187,143 @@ class TpuUniverse:
                 root[key] = op.get("value")
             elif action == "del":
                 root.pop(key, None)
+
+    # -- patch-emitting ingestion (the incremental codepath) ----------------
+
+    def apply_changes_with_patches(
+        self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]
+    ) -> Dict[str, List[Dict[str, Any]]]:
+        """Causally-gated ingestion that also emits the reference Patch
+        stream per replica (micromerge.ts:25-30).  Uses the faithful
+        interleaved per-op path; the patch-free fast path is apply_changes."""
+        batches = self._normalize_batches(per_replica)
+
+        encoded: List[np.ndarray] = []
+        makelist_patches: List[List[Dict[str, Any]]] = []
+        max_rows = 0
+        for r, changes in enumerate(batches):
+            ordered = self._gate(r, changes)
+            rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
+            self._apply_host_ops(r, host_ops)
+            mk = [
+                {**op, "path": ["text"]}
+                for op in host_ops
+                if op["action"] == "makeList"
+            ]
+            makelist_patches.append(mk)
+            self.lengths[r] += counts["insert"]
+            self.mark_counts[r] += counts["mark"]
+            encoded.append(rows)
+            max_rows = max(max_rows, rows.shape[0])
+
+        self._ensure_capacity(max(self.lengths, default=0), max(self.mark_counts, default=0))
+        out: Dict[str, List[Dict[str, Any]]] = {
+            name: list(makelist_patches[r]) for r, name in enumerate(self.replica_ids)
+        }
+        if max_rows == 0:
+            return out
+        pad = bucket_length(max_rows)
+        ops = np.stack([pad_rows(rows, pad) for rows in encoded])
+        ranks = self._ranks()
+        self.states, records = K.apply_ops_patched_batch(
+            self.states, jax.numpy.asarray(ops), jax.numpy.asarray(ranks)
+        )
+        records = {k: np.asarray(v) for k, v in records.items()}
+        for r, name in enumerate(self.replica_ids):
+            state = index_state(self.states, r)
+            table = self._mark_op_table(state)
+            op_rows = ops[r]
+            out[name].extend(self._assemble_patches(records, r, op_rows, table))
+        return out
+
+    def _assemble_patches(
+        self,
+        records: Dict[str, np.ndarray],
+        r: int,
+        op_rows: np.ndarray,
+        table: Dict[str, Dict[str, Any]],
+    ) -> List[Dict[str, Any]]:
+        """Reference-format patches from per-op device records."""
+        patches: List[Dict[str, Any]] = []
+        op_ids = list(table)
+
+        def decode_mask(row: np.ndarray) -> Dict[str, Any]:
+            present = frozenset(
+                op_id
+                for m, op_id in enumerate(op_ids)
+                if row[m // 32] >> (m % 32) & 1
+            )
+            return ops_to_marks(present, table)
+
+        num_ops = records["kind"].shape[1]
+        for i in range(num_ops):
+            kind = int(records["kind"][r, i])
+            if kind == K.KIND_PAD or not records["valid"][r, i]:
+                continue
+            if kind == K.KIND_INSERT:
+                patches.append(
+                    {
+                        "path": ["text"],
+                        "action": "insert",
+                        "index": int(records["index"][r, i]),
+                        "values": [chr(int(records["char"][r, i]))],
+                        "marks": decode_mask(records["ins_mask"][r, i]),
+                    }
+                )
+            elif kind == K.KIND_DELETE:
+                patches.append(
+                    {
+                        "path": ["text"],
+                        "action": "delete",
+                        "index": int(records["index"][r, i]),
+                        "count": 1,
+                    }
+                )
+            elif kind == K.KIND_MARK:
+                patches.extend(
+                    self._assemble_mark_patches(records, r, i, op_rows[i])
+                )
+        return patches
+
+    def _assemble_mark_patches(
+        self, records: Dict[str, np.ndarray], r: int, i: int, op_row: np.ndarray
+    ) -> List[Dict[str, Any]]:
+        """Reference peritext.ts:198-221: a patch opens at every written
+        DURING slot whose effective marks change, and closes at the next
+        written slot (or the end of the walk)."""
+        written = np.flatnonzero(records["written"][r, i])
+        if written.size == 0:
+            return []
+        during = records["during"][r, i]
+        changed = records["changed"][r, i]
+        vis = records["vis"][r, i]
+        obj_len = int(records["obj_len"][r, i])
+        action = "addMark" if int(op_row[K.K_MACTION]) == 0 else "removeMark"
+        mark_type = ALL_MARKS[int(op_row[K.K_MTYPE])]
+        attrs = self.attrs.decode(int(op_row[K.K_MATTR]))
+
+        patches: List[Dict[str, Any]] = []
+        for j, p in enumerate(written):
+            if not (during[p] and changed[p]):
+                continue
+            start = int(vis[p])
+            if j + 1 < written.size:
+                end = int(vis[written[j + 1]])
+            else:
+                end = obj_len
+            # finishPartialPatch filters (peritext.ts:269-281).
+            if end > start and start < obj_len:
+                patch: Dict[str, Any] = {
+                    "action": action,
+                    "markType": mark_type,
+                    "path": ["text"],
+                    "startIndex": start,
+                    "endIndex": min(end, obj_len),
+                }
+                if action == "addMark" and mark_type in ("link", "comment"):
+                    patch["attrs"] = attrs
+                patches.append(patch)
+        return patches
 
     # -- materialization ----------------------------------------------------
 

@@ -7,13 +7,14 @@ The data plane (batched op application) lives in ``peritext_tpu.ops``.
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.pubsub import Publisher
 from peritext_tpu.runtime.queue import ChangeQueue
-from peritext_tpu.runtime.sync import apply_changes, causal_sort, sync_pair
+from peritext_tpu.runtime.sync import apply_changes, causal_order, causal_sort, sync_pair
 
 __all__ = [
     "ChangeLog",
     "Publisher",
     "ChangeQueue",
     "apply_changes",
+    "causal_order",
     "causal_sort",
     "sync_pair",
 ]

@@ -37,6 +37,41 @@ def apply_changes(doc: Any, changes: Sequence[Change]) -> List[Dict[str, Any]]:
     return patches
 
 
+def causal_order(changes: Sequence[Change], clock: Dict[str, int] | None = None) -> List[Change]:
+    """Delivery-order-preserving causal ordering.
+
+    The exact order the reference's applyChanges retry loop (test/merge.ts:
+    4-23) would apply a batch in: changes apply in delivery order, with
+    causally-unready ones deferred to the back of the queue.  This matters
+    beyond correctness: *patch streams are delivery-order-sensitive* (patch
+    indices depend on what applied before), so batched engines must use this
+    order — not an arbitrary topological sort — to emit the same patches an
+    incremental replica would.
+    """
+    clock = dict(clock or {})
+    pending = deque(changes)
+    ordered: List[Change] = []
+    stuck = 0
+    while pending:
+        change = pending.popleft()
+        ready = clock.get(change["actor"], 0) == change["seq"] - 1 and all(
+            clock.get(actor, 0) >= dep
+            for actor, dep in (change.get("deps") or {}).items()
+        )
+        if ready:
+            clock[change["actor"]] = change["seq"]
+            ordered.append(change)
+            stuck = 0
+        else:
+            pending.append(change)
+            stuck += 1
+            if stuck > len(pending):
+                raise ValueError(
+                    f"causal_order: {len(pending)} changes have unsatisfiable dependencies"
+                )
+    return ordered
+
+
 def causal_sort(changes: Sequence[Change], clock: Dict[str, int] | None = None) -> List[Change]:
     """Order a batch of changes so each one's causal dependencies precede it.
 

@@ -1,0 +1,135 @@
+"""The engine's incremental codepath: device-emitted patch streams must be
+byte-identical to the oracle's (the dual-path invariant, SURVEY.md §1)."""
+import random
+
+import pytest
+
+from peritext_tpu.fuzz import _random_add_mark, _random_delete, _random_insert, _random_remove_mark
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.oracle import Doc, accumulate_patches
+from peritext_tpu.testing import generate_docs
+
+from tests.test_engine import SCENARIOS
+
+
+def run_patch_differential(
+    *, initial_text="The Peritext editor", pre_ops=None, input_ops1=(), input_ops2=()
+):
+    """Replay the concurrent-write harness; a fresh oracle replica and a
+    fresh engine replica both ingest the full change stream, and their patch
+    streams must match patch-for-patch."""
+    docs, _, initial_change = generate_docs(initial_text)
+    doc1, doc2 = docs
+
+    def with_path(ops):
+        return [{**op, "path": ["text"]} for op in ops]
+
+    stream = [initial_change]
+    if pre_ops:
+        change0, _ = doc1.change(with_path(pre_ops))
+        doc2.apply_change(change0)
+        stream.append(change0)
+    change1, _ = doc1.change(with_path(input_ops1))
+    change2, _ = doc2.change(with_path(input_ops2))
+    doc2.apply_change(change1)
+    doc1.apply_change(change2)
+    stream.extend([change1, change2])
+
+    oracle = Doc("observer")
+    oracle_patches = []
+    for change in stream:
+        oracle_patches.extend(oracle.apply_change(change))
+
+    uni = TpuUniverse(["observer"])
+    engine_patches = uni.apply_changes_with_patches({"observer": stream})["observer"]
+
+    assert engine_patches == oracle_patches
+    # And the accumulated incremental state equals both batch views.
+    spans = oracle.get_text_with_formatting(["text"])
+    assert accumulate_patches(engine_patches) == spans
+    assert uni.spans("observer") == spans
+
+
+@pytest.mark.parametrize("name", list(SCENARIOS))
+def test_engine_patches_match_oracle(name):
+    run_patch_differential(**SCENARIOS[name])
+
+
+def test_multichar_deletion_splits_into_single_char_patches():
+    docs, _, initial_change = generate_docs()
+    change, _ = docs[0].change(
+        [{"path": ["text"], "action": "delete", "index": 5, "count": 2}]
+    )
+    uni = TpuUniverse(["obs"])
+    patches = uni.apply_changes_with_patches({"obs": [initial_change, change]})["obs"]
+    assert patches[-2:] == [
+        {"path": ["text"], "action": "delete", "index": 5, "count": 1},
+        {"path": ["text"], "action": "delete", "index": 5, "count": 1},
+    ]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_engine_patch_stream_unsynced_writers(seed):
+    """Concurrent writers who never sync with each other: the observer's
+    delivery order interleaves causally-independent changes, and the engine
+    must emit the same order-sensitive patch stream the oracle does."""
+    rng = random.Random(seed + 100)
+    docs, _, initial_change = generate_docs("ABCDEFG", 3)
+    stream = [initial_change]
+    for _ in range(15):
+        doc = docs[rng.randrange(3)]
+        kind = rng.choice(["insert", "remove", "addMark"])
+        if kind == "insert":
+            op = _random_insert(rng, doc, 3)
+        elif kind == "remove":
+            op = _random_delete(rng, doc)
+        else:
+            op = _random_add_mark(rng, doc, [])
+        if op is None:
+            continue
+        change, _ = doc.change([op])
+        stream.append(change)  # delivery order = generation order, no syncs
+
+    oracle = Doc("observer")
+    oracle_patches = []
+    for change in stream:
+        oracle_patches.extend(oracle.apply_change(change))
+    uni = TpuUniverse(["observer"])
+    engine_patches = uni.apply_changes_with_patches({"observer": stream})["observer"]
+    assert engine_patches == oracle_patches, f"seed {seed}"
+    assert uni.spans("observer") == oracle.get_text_with_formatting(["text"])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_engine_patch_stream_random_differential(seed):
+    rng = random.Random(seed)
+    docs, _, initial_change = generate_docs("ABCDE", 2)
+    stream = [initial_change]
+    comment_history = []
+    for _ in range(30):
+        doc = docs[rng.randrange(2)]
+        kind = rng.choice(["insert", "remove", "addMark", "removeMark"])
+        if kind == "insert":
+            op = _random_insert(rng, doc, 3)
+        elif kind == "remove":
+            op = _random_delete(rng, doc)
+        elif kind == "addMark":
+            op = _random_add_mark(rng, doc, comment_history)
+        else:
+            op = _random_remove_mark(rng, doc, comment_history, False)
+        if op is None:
+            continue
+        change, _ = doc.change([op])
+        stream.append(change)
+        # Keep both writers synced so indices stay meaningful.
+        other = docs[1 - docs.index(doc)]
+        other.apply_change(change)
+
+    oracle = Doc("observer")
+    oracle_patches = []
+    for change in stream:
+        oracle_patches.extend(oracle.apply_change(change))
+    uni = TpuUniverse(["observer"])
+    engine_patches = uni.apply_changes_with_patches({"observer": stream})["observer"]
+    assert engine_patches == oracle_patches, f"seed {seed}"
+    assert accumulate_patches(engine_patches) == oracle.get_text_with_formatting(["text"])
