@@ -108,6 +108,79 @@ def encode_internal_op(
     return None
 
 
+_BOUNDARY_NAME = {v: k for k, v in _BOUNDARY_KIND.items()}
+
+
+def decode_internal_op(
+    row: np.ndarray,
+    actors: ActorRegistry,
+    attrs: AttrRegistry,
+    obj: Optional[str],
+) -> Dict[str, Any]:
+    """Inverse of encode_internal_op: an op row back to the wire format.
+
+    ``obj`` is the containing list's object id (op rows don't carry it; the
+    log envelope does).  Round-trip fidelity is tested in
+    tests/test_native_codec.py.
+    """
+    from peritext_tpu.ids import make_op_id
+    from peritext_tpu.schema import ALL_MARKS
+
+    op_id = make_op_id(int(row[K.K_CTR]), actors.actor(int(row[K.K_ACT])))
+    kind = int(row[K.K_KIND])
+    if kind == K.KIND_INSERT:
+        op: Dict[str, Any] = {
+            "opId": op_id,
+            "action": "set",
+            "obj": obj,
+            "insert": True,
+            "value": chr(int(row[K.K_PAYLOAD])),
+        }
+        if int(row[K.K_REF_CTR]) != 0 or int(row[K.K_REF_ACT]) != 0:
+            op["elemId"] = make_op_id(
+                int(row[K.K_REF_CTR]), actors.actor(int(row[K.K_REF_ACT]))
+            )
+        # Match the reference's key order: elemId precedes insert/value in
+        # serialized traces; key order is irrelevant to dict equality.
+        return op
+    if kind == K.KIND_DELETE:
+        return {
+            "opId": op_id,
+            "action": "del",
+            "obj": obj,
+            "elemId": make_op_id(
+                int(row[K.K_REF_CTR]), actors.actor(int(row[K.K_REF_ACT]))
+            ),
+        }
+    if kind == K.KIND_MARK:
+        op = {
+            "opId": op_id,
+            "action": "addMark" if int(row[K.K_MACTION]) == 0 else "removeMark",
+            "obj": obj,
+            "start": {
+                "type": _BOUNDARY_NAME[int(row[K.K_SKIND])],
+                "elemId": make_op_id(
+                    int(row[K.K_SCTR]), actors.actor(int(row[K.K_SACT]))
+                ),
+            },
+            "markType": ALL_MARKS[int(row[K.K_MTYPE])],
+        }
+        if int(row[K.K_EKIND]) == 2:
+            op["end"] = {"type": "endOfText"}
+        else:
+            op["end"] = {
+                "type": _BOUNDARY_NAME[int(row[K.K_EKIND])],
+                "elemId": make_op_id(
+                    int(row[K.K_ECTR]), actors.actor(int(row[K.K_EACT]))
+                ),
+            }
+        attr = attrs.decode(int(row[K.K_MATTR]))
+        if attr is not None:
+            op["attrs"] = attr
+        return op
+    raise ValueError(f"cannot decode op row of kind {kind}")
+
+
 def encode_changes(
     changes: Sequence[Dict[str, Any]],
     actors: ActorRegistry,

@@ -1,0 +1,212 @@
+"""Pallas TPU kernels for the merge hot path.
+
+The XLA scan in kernels.merge_step streams the whole replica batch through
+HBM once per op step.  The Pallas text-phase kernel instead keeps each
+replica's element arrays resident in VMEM across its *entire* op list —
+HBM traffic drops from O(ops x state) to O(state): one read and one write
+per replica per batch.
+
+Layout: the grid walks replica blocks of B=8 (the f32/i32 sublane tile);
+each block holds 8 replicas' arrays as [B, C] tiles (replicas in sublanes,
+document positions in lanes).  The per-op loop applies op l of all 8
+replicas simultaneously — replicas are independent, so every step is a
+row-wise vector op: masked compares, cross-lane min-reductions for the RGA
+position rule, and a lane roll for the splice.  Actor-rank comparisons use a
+pre-gathered elem_rank plane (maintained through splices in-kernel) so the
+kernel needs no gathers at all.
+
+Semantics are identical to kernels._apply_text_op (same RGA position rule;
+differential-tested in tests/test_pallas.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from peritext_tpu.ops import kernels as K
+
+# Extended op row: kernels.OP_FIELDS fields + the op actor's rank, padded so
+# a row is 16 lanes.
+F_RANK = K.OP_FIELDS  # 15
+OPF = 16
+REPLICA_BLOCK = 8
+
+
+def _text_kernel(ops_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
+                 ec, ea, er, dl, ch, oi, ln, *, num_ops: int):
+    b, c = ec_in.shape
+    ec[:] = ec_in[:]
+    ea[:] = ea_in[:]
+    er[:] = er_in[:]
+    dl[:] = dl_in[:]
+    ch[:] = ch_in[:]
+    oi[:] = oi_in[:]
+    ln[:] = ln_in[:]
+    pos = lax.broadcasted_iota(jnp.int32, (b, c), 1)
+
+    def body(l, _):
+        def col(f):
+            return ops_ref[:, pl.ds(l * OPF + f, 1)]  # [B, 1]
+
+        kind = col(K.K_KIND)
+        ctr = col(K.K_CTR)
+        act = col(K.K_ACT)
+        ref_ctr = col(K.K_REF_CTR)
+        ref_act = col(K.K_REF_ACT)
+        payload = col(K.K_PAYLOAD)
+        op_rank = col(F_RANK)
+
+        ecv, eav, erv = ec[:], ea[:], er[:]
+        dlv, chv, oiv = dl[:], ch[:], oi[:]
+        lnv = ln[:]
+
+        live = pos < lnv
+        is_ins = kind == K.KIND_INSERT
+        is_del = kind == K.KIND_DELETE
+
+        match = live & (ecv == ref_ctr) & (eav == ref_act)
+        dlv = jnp.where(match & is_del, 1, dlv)
+
+        # RGA position rule (kernels._rga_insert_position, vectorized over
+        # the replica sublane): after the reference element, past the
+        # contiguous run of greater-id elements.
+        is_head = (ref_ctr == 0) & (ref_act == 0)
+        first = jnp.min(jnp.where(match, pos, c), axis=1, keepdims=True)
+        idx = jnp.where(is_head, -1, first)
+        gt = (ecv > ctr) | ((ecv == ctr) & (erv > op_rank))
+        stop = (pos > idx) & ~(live & gt)
+        t = jnp.min(jnp.where(stop, pos, c), axis=1, keepdims=True)
+        keep = pos < t
+        here = pos == t
+
+        def splice(x, v):
+            return jnp.where(keep, x, jnp.where(here, v, pltpu.roll(x, 1, 1)))
+
+        ec[:] = jnp.where(is_ins, splice(ecv, ctr), ecv)
+        ea[:] = jnp.where(is_ins, splice(eav, act), eav)
+        er[:] = jnp.where(is_ins, splice(erv, op_rank), erv)
+        dl[:] = jnp.where(is_ins, splice(dlv, 0), dlv)
+        ch[:] = jnp.where(is_ins, splice(chv, payload), chv)
+        oi[:] = jnp.where(is_ins, splice(oiv, -1), oiv)
+        ln[:] = lnv + is_ins.astype(jnp.int32)
+        return 0
+
+    lax.fori_loop(0, num_ops, body, 0)
+
+
+def text_phase_pallas(
+    elem_ctr: jax.Array,  # [R, C] int32
+    elem_act: jax.Array,
+    deleted: jax.Array,  # [R, C] bool
+    chars: jax.Array,
+    length: jax.Array,  # [R] int32
+    text_ops: jax.Array,  # [R, L, OP_FIELDS] int32
+    ranks: jax.Array,  # [A] int32
+    interpret: bool = False,
+):
+    """Run the text phase in VMEM.  Returns the updated element arrays plus
+    the orig-index permutation plane for boundary-table realignment."""
+    r, c = elem_ctr.shape
+    num_ops = text_ops.shape[1]
+    if r % REPLICA_BLOCK != 0:
+        raise ValueError(f"replica count {r} must be a multiple of {REPLICA_BLOCK}")
+    if c % 128 != 0:
+        raise ValueError(f"capacity {c} must be a multiple of 128")
+
+    elem_rank = ranks[elem_act]
+    orig_idx = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32), (r, c))
+    op_ranks = ranks[text_ops[:, :, K.K_ACT]]
+    ops_ext = jnp.concatenate(
+        [
+            text_ops,
+            op_ranks[:, :, None],
+            jnp.zeros((r, num_ops, OPF - K.OP_FIELDS - 1), jnp.int32),
+        ],
+        axis=2,
+    ).reshape(r, num_ops * OPF)
+
+    b = REPLICA_BLOCK
+    row_spec = pl.BlockSpec((b, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    ops_spec = pl.BlockSpec((b, num_ops * OPF), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    len_spec = pl.BlockSpec((b, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((r, c), jnp.int32)
+
+    outs = pl.pallas_call(
+        functools.partial(_text_kernel, num_ops=num_ops),
+        grid=(r // b,),
+        in_specs=[ops_spec] + [row_spec] * 6 + [len_spec],
+        out_specs=[row_spec] * 6 + [len_spec],
+        out_shape=[shape] * 6 + [jax.ShapeDtypeStruct((r, 1), jnp.int32)],
+        interpret=interpret,
+    )(
+        ops_ext,
+        elem_ctr,
+        elem_act,
+        elem_rank,
+        deleted.astype(jnp.int32),
+        chars,
+        orig_idx,
+        length[:, None],
+    )
+    ec, ea, _er, dl, ch, oi, ln = outs
+    return ec, ea, dl.astype(bool), ch, oi, ln[:, 0]
+
+
+def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool = False):
+    """Fast merge with the Pallas text phase: VMEM-resident text application,
+    then the standard boundary permute + mark phase (kernels.merge_step's
+    tail), batched over replicas."""
+    ec, ea, dl, ch, oi, ln = text_phase_pallas(
+        states.elem_ctr,
+        states.elem_act,
+        states.deleted,
+        states.chars,
+        states.length,
+        text_ops,
+        ranks,
+        interpret=interpret,
+    )
+
+    def tail(state, orig_idx, m_ops):
+        bnd_def, bnd_mask = K._permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+        carry = (
+            bnd_def,
+            bnd_mask,
+            state.mark_ctr,
+            state.mark_act,
+            state.mark_action,
+            state.mark_type,
+            state.mark_attr,
+            state.mark_count,
+        )
+        (bnd_def, bnd_mask, mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count), _ = lax.scan(
+            lambda cry, op: K._apply_mark_fast(cry, op, state.elem_ctr, state.elem_act, state.length),
+            carry,
+            m_ops,
+        )
+        return dataclasses.replace(
+            state,
+            bnd_def=bnd_def,
+            bnd_mask=bnd_mask,
+            mark_ctr=mark_ctr,
+            mark_act=mark_act,
+            mark_action=mark_action,
+            mark_type=mark_type,
+            mark_attr=mark_attr,
+            mark_count=mark_count,
+        )
+
+    new_states = dataclasses.replace(
+        states, elem_ctr=ec, elem_act=ea, deleted=dl, chars=ch, length=ln
+    )
+    return jax.vmap(tail, in_axes=(0, 0, 0))(new_states, oi, mark_ops)
+
+
+def merge_step_pallas_jit(interpret: bool = False):
+    return jax.jit(functools.partial(merge_step_pallas, interpret=interpret))

@@ -69,3 +69,101 @@ class ChangeLog:
     @property
     def actors(self) -> List[str]:
         return list(self._queues)
+
+    # -- binary persistence (native codec) ----------------------------------
+
+    def to_bytes(self) -> bytes:
+        """Serialize the whole log: columnar-varint op rows (the native
+        codec, runtime/native_codec.py) + a JSON envelope for headers,
+        intern tables, and structural ops.
+
+        The binary analog of the reference's JSON change format
+        (micromerge.ts:60-71, which cites Automerge's binary change format
+        as the real-world encoding).
+        """
+        import json
+
+        import numpy as np
+
+        from peritext_tpu.ids import ActorRegistry
+        from peritext_tpu.ops import kernels as K
+        from peritext_tpu.ops.encode import AttrRegistry, encode_internal_op
+        from peritext_tpu.runtime.native_codec import encode_columns
+
+        actors = ActorRegistry()
+        attrs = AttrRegistry()
+        rows: List[Any] = []
+        obj_table: List[Any] = []
+        obj_index: Dict[Any, int] = {}
+        obj_col: List[int] = []
+        envelope: Dict[str, Any] = {"changes": []}
+        for queue in self._queues.values():
+            for change in queue:
+                header = {k: change[k] for k in ("actor", "seq", "deps", "startOp")}
+                ops_meta: List[Any] = []
+                for op in change["ops"]:
+                    row = encode_internal_op(op, actors, attrs)
+                    if row is None:
+                        ops_meta.append(op)  # structural op: raw JSON
+                    else:
+                        ops_meta.append(None)  # device op: row stream
+                        rows.append(row)
+                        obj = op.get("obj")
+                        if obj not in obj_index:
+                            obj_index[obj] = len(obj_table)
+                            obj_table.append(obj)
+                        obj_col.append(obj_index[obj])
+                envelope["changes"].append({"header": header, "ops": ops_meta})
+        matrix = (
+            np.concatenate(
+                [np.stack(rows).T, np.asarray(obj_col, np.int32)[None, :]]
+            )
+            if rows
+            else np.zeros((K.OP_FIELDS + 1, 0), np.int32)
+        )
+        payload = encode_columns(matrix)
+        envelope["obj_table"] = obj_table
+        envelope["actors"] = actors.actors
+        envelope["attrs"] = attrs.values
+        envelope["n_rows"] = matrix.shape[1]
+        head = json.dumps(envelope).encode()
+        return (
+            len(head).to_bytes(8, "little") + head + payload
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ChangeLog":
+        import json
+
+        from peritext_tpu.ids import ActorRegistry
+        from peritext_tpu.ops import kernels as K
+        from peritext_tpu.ops.encode import AttrRegistry, decode_internal_op
+        from peritext_tpu.runtime.native_codec import decode_columns
+
+        head_len = int.from_bytes(data[:8], "little")
+        envelope = json.loads(data[8 : 8 + head_len])
+        matrix = decode_columns(
+            data[8 + head_len :], K.OP_FIELDS + 1, envelope["n_rows"]
+        )
+        actors = ActorRegistry()
+        for actor in envelope["actors"]:
+            actors.intern(actor)
+        attrs = AttrRegistry()
+        for attr in envelope["attrs"]:
+            attrs.intern(attr)
+
+        log = cls()
+        row_i = 0
+        for entry in envelope["changes"]:
+            ops = []
+            for op_meta in entry["ops"]:
+                if op_meta is not None:
+                    ops.append(op_meta)
+                else:
+                    obj = envelope["obj_table"][int(matrix[K.OP_FIELDS, row_i])]
+                    ops.append(
+                        decode_internal_op(matrix[:, row_i], actors, attrs, obj)
+                    )
+                    row_i += 1
+            log.record({**entry["header"], "ops": ops})
+        return log
