@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Two-editor live demo with manual sync — the index.ts demo, terminal style.
+
+Reference: /root/reference/src/index.ts — alice and bob share a Publisher;
+their queues are dropped to manual mode and a "Sync" action flushes both.
+This script seeds the same document (one of each mark) and walks a short
+concurrent-editing session, rendering formatted spans and the op log after
+each step.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from peritext_tpu.bridge import EditorNetwork, describe_op  # noqa: E402
+
+BOLD, DIM, RESET, ITALIC, UNDER = "\033[1m", "\033[2m", "\033[0m", "\033[3m", "\033[4m"
+
+
+def render(spans):
+    out = []
+    for span in spans:
+        text = span["text"]
+        marks = span["marks"]
+        prefix = ""
+        if marks.get("strong"):
+            prefix += BOLD
+        if marks.get("em"):
+            prefix += ITALIC
+        if marks.get("link"):
+            prefix += UNDER
+        suffix = RESET if prefix else ""
+        note = ""
+        if marks.get("comment"):
+            note = f"{DIM}[{','.join(c['id'] for c in marks['comment'])}]{RESET}"
+        out.append(f"{prefix}{text}{suffix}{note}")
+    return "".join(out)
+
+
+def show(net, label):
+    print(f"--- {label}")
+    for name, editor in net.editors.items():
+        print(f"  {name:>5}: {render(editor.spans())}")
+
+
+def main():
+    # Seed matches the reference demo: bold+italic+comment+link present.
+    net = EditorNetwork(["alice", "bob"], initial_text="The Peritext editor")
+    net["alice"].toggle_mark(0, 3, "strong")
+    net["alice"].toggle_mark(4, 12, "em")
+    net["alice"].add_comment(4, 12, "seeded comment")
+    net["alice"].add_link(13, 19, "https://inkandswitch.com/peritext")
+    net.sync_all()
+    show(net, "seeded, synced")
+
+    # Concurrent session: offline edits on both sides.
+    net["alice"].insert(19, " rocks")
+    net["alice"].toggle_mark(13, 25, "strong")
+    net["bob"].delete(0, 4)
+    net["bob"].insert(0, "A ")
+    show(net, "concurrent edits (not yet synced)")
+
+    net.sync_all()
+    show(net, "after sync (converged)")
+    assert net.converged()
+
+    print("--- op log (alice)")
+    for change in net["alice"].change_log:
+        for op in change["ops"]:
+            print("   ", describe_op(op))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,34 @@ Patch = Dict[str, Any]
 Step = Tuple
 
 
+def describe_op(op: Dict[str, Any]) -> str:
+    """Human-readable one-liner for an internal op (the live op-log panel,
+    reference describeOp, bridge.ts:90-104)."""
+    action = op.get("action")
+    op_id = op.get("opId", "?")
+    if action == "set" and op.get("insert"):
+        after = op.get("elemId") or "HEAD"
+        return f"{op_id}: insert {op.get('value')!r} after {after}"
+    if action == "del":
+        return f"{op_id}: delete {op.get('elemId')}"
+    if action in ("addMark", "removeMark"):
+        def side(b):
+            if b.get("type") in ("startOfText", "endOfText"):
+                return b["type"]
+            return f"{b['type']}({b.get('elemId')})"
+
+        attrs = f" {op['attrs']}" if op.get("attrs") else ""
+        return (
+            f"{op_id}: {action} {op.get('markType')}{attrs} "
+            f"from {side(op['start'])} to {side(op['end'])}"
+        )
+    if action in ("makeList", "makeMap"):
+        return f"{op_id}: {action} {op.get('key')!r}"
+    if action == "set":
+        return f"{op_id}: set {op.get('key')!r} = {op.get('value')!r}"
+    return f"{op_id}: {action}"
+
+
 class Comment:
     """Side-table entry for a comment body (reference comment.ts:1-12).
 

@@ -1,0 +1,89 @@
+"""Multi-host scaling over DCN.
+
+One JAX program spans hosts via ``jax.distributed``; the same
+``(replica, seq)`` mesh from :mod:`peritext_tpu.parallel.mesh` then covers
+every host's devices, with the replica axis laid out so intra-slice
+communication (the sequence-parallel scan carries, if used) rides ICI and
+only the cross-replica digest reductions cross DCN — replicas never
+communicate during op application, so DCN carries almost nothing.
+
+On the host side, the replication plumbing is already multi-host shaped:
+change logs ship as native-codec bytes (runtime/log.py to_bytes/from_bytes)
+over whatever transport connects the hosts, and each host's universe ingests
+through the same causal gate.  This module provides the initialization and
+a host-sharded universe helper; it cannot be exercised in this repo's
+single-host image (the test suite covers the mesh path on a virtual
+8-device mesh instead).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from peritext_tpu.parallel.mesh import make_mesh
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host JAX program (idempotent).
+
+    With TPU metadata available (GKE/GCE), bare ``jax.distributed.
+    initialize()`` autodiscovers everything; otherwise pass coordinator
+    address + process layout explicitly.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as err:
+        # jax 0.9 raises "distributed.initialize should only be called once."
+        if "only be called once" not in str(err) and "already initialized" not in str(err):
+            raise
+
+
+def global_mesh(seq_axis: int = 1) -> jax.sharding.Mesh:
+    """A (replica, seq) mesh over every device of every host.
+
+    The device order groups each host's local devices contiguously along the
+    replica axis, so a replica shard never straddles hosts and sequence
+    shards (which do communicate) stay on one host's ICI domain when
+    ``seq_axis`` divides the local device count.
+    """
+    return make_mesh(jax.devices(), seq_axis=seq_axis)
+
+
+def local_replica_slice(num_replicas: int) -> slice:
+    """The [start, stop) replica-batch rows owned by this host, for building
+    host-local state that jax.make_array_from_process_local_data assembles
+    into the global batch.  The batch must divide evenly across hosts (the
+    downstream even-split NamedSharding cannot represent a remainder); pad
+    the batch to a multiple of process_count() otherwise."""
+    n = jax.process_count()
+    if num_replicas % n != 0:
+        raise ValueError(
+            f"replica batch of {num_replicas} must divide across {n} hosts; pad it"
+        )
+    per = num_replicas // n
+    start = jax.process_index() * per
+    return slice(start, start + per)
+
+
+def assemble_global_states(local_states, global_shape_states, mesh) -> object:
+    """Assemble per-host local [r_local, ...] state pytrees into one
+    mesh-sharded global batch (wraps jax.make_array_from_process_local_data
+    leaf-wise)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def leaf(local, global_leaf):
+        spec = P("replica", *([None] * (local.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), local, global_leaf.shape
+        )
+
+    return jax.tree.map(leaf, local_states, global_shape_states)
