@@ -7,7 +7,8 @@ struct-of-arrays tensors and applies operations with vectorized index
 arithmetic, masked shifts, bitset algebra, and prefix scans — `vmap`-able over
 thousands of replicas and shardable across TPU chips.
 """
+from peritext_tpu.ops.doc import TpuDoc
 from peritext_tpu.ops.state import DocState, make_empty_state
 from peritext_tpu.ops.universe import TpuUniverse
 
-__all__ = ["DocState", "make_empty_state", "TpuUniverse"]
+__all__ = ["DocState", "make_empty_state", "TpuDoc", "TpuUniverse"]

@@ -1,0 +1,288 @@
+"""TpuDoc: the full document API with device-resident state.
+
+A drop-in peer of :class:`peritext_tpu.oracle.Doc`: local change generation
+(``change()``), remote ingestion behind the causal gate (``apply_change()``),
+batch materialization, patch streams, and cursors — with every document
+mutation and lookup executed by the jitted kernels on a DocState.  The host
+keeps only the control plane (seq/clock/max_op, registries, the root map).
+
+Local generation mirrors the reference change() path (micromerge.ts:308-441):
+each input op resolves its anchors against the *current* device state
+(index -> element id with the tombstone-peek rule for inserts), expands into
+internal ops, and applies immediately through the patch-emitting kernel, so
+returned patches are exactly the oracle's.
+
+One deliberate deviation, documented: a multi-character delete resolves all
+of its target element ids in one batched device query (the k consecutive
+visible elements from the delete index) instead of one query per tombstone.
+The results are identical — deleting the visible element at a constant index
+k times tombstones exactly those elements (micromerge.ts:362-392's
+constant-index rule) — but it costs one device round trip instead of k.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from peritext_tpu.ids import make_op_id
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.state import index_state, stack_states
+from peritext_tpu.ops.universe import TpuUniverse, apply_root_op, assemble_patches
+from peritext_tpu.schema import MARK_SPEC, MARK_TYPE_ID
+
+Change = Dict[str, Any]
+Patch = Dict[str, Any]
+
+
+class TpuDoc:
+    def __init__(self, actor_id: str, capacity: int = 256, max_mark_ops: int = 64):
+        self._uni = TpuUniverse([actor_id], capacity=capacity, max_mark_ops=max_mark_ops)
+        self.actor_id = actor_id
+        self._actor_int = self._uni.actors.intern(actor_id)
+        self.seq = 0
+        self.max_op = 0
+
+    # -- views ---------------------------------------------------------------
+
+    @property
+    def clock(self) -> Dict[str, int]:
+        return self._uni.clock(0)
+
+    @property
+    def root(self) -> Dict[str, Any]:
+        """Root view; ``root["text"]`` materializes the visible characters."""
+        root = dict(self._uni.roots[0])
+        if self._text_obj() is not None:
+            root["text"] = list(self._uni.text(0))
+        return root
+
+    def get_text_with_formatting(self, path: Sequence[str]) -> List[Dict[str, Any]]:
+        if list(path) != ["text"]:
+            raise KeyError(f"No list at path {path!r}")
+        return self._uni.spans(0)
+
+    def get_cursor(self, path: Sequence[str], index: int) -> Dict[str, Any]:
+        return self._uni.get_cursor(0, index)
+
+    def resolve_cursor(self, cursor: Dict[str, Any]) -> int:
+        return self._uni.resolve_cursor(0, cursor)
+
+    def _text_obj(self) -> Optional[str]:
+        return self._uni.roots[0].get("__lists__", {}).get("text")
+
+    def _state(self):
+        return index_state(self._uni.states, 0)
+
+    # -- remote ingestion ----------------------------------------------------
+
+    def apply_change(self, change: Change) -> List[Patch]:
+        """Causal gate identical to the oracle's (micromerge.ts:501-509)."""
+        last_seq = self.clock.get(change["actor"], 0)
+        if change["seq"] != last_seq + 1:
+            raise ValueError(
+                f"Expected sequence number {last_seq + 1}, got {change['seq']}"
+            )
+        for actor, dep in (change.get("deps") or {}).items():
+            if self.clock.get(actor, 0) < dep:
+                raise ValueError(f"Missing dependency: change {dep} by actor {actor}")
+        patches = self._uni.apply_changes_with_patches({self.actor_id: [change]})[
+            self.actor_id
+        ]
+        self.max_op = max(self.max_op, change["startOp"] + len(change["ops"]) - 1)
+        return patches
+
+    # -- local change generation ---------------------------------------------
+
+    def change(self, input_ops: Sequence[Dict[str, Any]]) -> Tuple[Change, List[Patch]]:
+        deps = dict(self.clock)
+        # Seq resumes from our own clock entry after log-replay recovery
+        # (same rule as oracle.Doc.change; see its comment).
+        self.seq = max(self.seq, self.clock.get(self.actor_id, 0)) + 1
+        self._uni.clocks[0][self.actor_id] = self.seq
+        change: Change = {
+            "actor": self.actor_id,
+            "seq": self.seq,
+            "deps": deps,
+            "startOp": self.max_op + 1,
+            "ops": [],
+        }
+        patches: List[Patch] = []
+        for input_op in input_ops:
+            patches.extend(self._generate_input_op(change, input_op))
+        return change, patches
+
+    def _elem_id(self, index: int, peek: bool) -> Tuple[int, int]:
+        ctr, act, found = K.visible_elem_id_jit(
+            self._state(), jax.numpy.int32(index), jax.numpy.bool_(peek)
+        )
+        if not bool(found):
+            raise IndexError(f"List index out of bounds: {index}")
+        return int(ctr), int(act)
+
+    def _generate_input_op(self, change: Change, input_op: Dict[str, Any]) -> List[Patch]:
+        action = input_op["action"]
+        path = list(input_op["path"])
+
+        if not path:  # root-map structural ops
+            return self._generate_root_op(change, input_op)
+        if path != ["text"] or self._text_obj() is None:
+            raise KeyError(f"No list at path {path!r}")
+        obj = self._text_obj()
+
+        rows: List[np.ndarray] = []
+        if action == "insert":
+            ref = (0, 0) if input_op["index"] == 0 else self._elem_id(
+                input_op["index"] - 1, peek=True
+            )
+            for value in input_op["values"]:
+                self.max_op += 1
+                row = np.zeros(K.OP_FIELDS, np.int32)
+                row[K.K_KIND] = K.KIND_INSERT
+                row[K.K_CTR] = self.max_op
+                row[K.K_ACT] = self._actor_int
+                row[K.K_REF_CTR], row[K.K_REF_ACT] = ref
+                row[K.K_PAYLOAD] = ord(value)
+                rows.append(row)
+                wire: Dict[str, Any] = {
+                    "opId": make_op_id(self.max_op, self.actor_id),
+                    "action": "set",
+                    "obj": obj,
+                    "insert": True,
+                    "value": value,
+                }
+                if ref != (0, 0):
+                    wire["elemId"] = make_op_id(ref[0], self._uni.actors.actor(ref[1]))
+                change["ops"].append(wire)
+                ref = (self.max_op, self._actor_int)
+        elif action == "delete":
+            # Constant-index rule: the targets are the next `count` visible
+            # elements starting at the index (see module docstring), resolved
+            # in one vmapped device query.
+            indices = jax.numpy.arange(input_op["count"], dtype=jax.numpy.int32) + input_op["index"]
+            ctrs, acts, founds = K.visible_elem_ids_batch(
+                self._state(), indices, jax.numpy.bool_(False)
+            )
+            founds = np.asarray(founds)
+            if not founds.all():
+                bad = int(np.flatnonzero(~founds)[0])
+                raise IndexError(
+                    f"List index out of bounds: {input_op['index'] + bad}"
+                )
+            targets = list(zip(np.asarray(ctrs).tolist(), np.asarray(acts).tolist()))
+            for ctr, act in targets:
+                self.max_op += 1
+                row = np.zeros(K.OP_FIELDS, np.int32)
+                row[K.K_KIND] = K.KIND_DELETE
+                row[K.K_CTR] = self.max_op
+                row[K.K_ACT] = self._actor_int
+                row[K.K_REF_CTR], row[K.K_REF_ACT] = ctr, act
+                rows.append(row)
+                change["ops"].append(
+                    {
+                        "opId": make_op_id(self.max_op, self.actor_id),
+                        "action": "del",
+                        "obj": obj,
+                        "elemId": make_op_id(ctr, self._uni.actors.actor(act)),
+                    }
+                )
+        elif action in ("addMark", "removeMark"):
+            rows_mark, wire = self._generate_mark_op(input_op, obj)
+            rows.append(rows_mark)
+            change["ops"].append(wire)
+        else:
+            raise NotImplementedError(f"{action} on a list")
+
+        return self._apply_rows(rows)
+
+    def _generate_mark_op(
+        self, input_op: Dict[str, Any], obj: str
+    ) -> Tuple[np.ndarray, Dict[str, Any]]:
+        """Anchor resolution (reference changeMark, peritext.ts:458-501)."""
+        mark_type = input_op["markType"]
+        end_grows = MARK_SPEC[mark_type].inclusive
+        vis_len = int(K.visible_length_jit(self._state()))
+        start = self._elem_id(input_op["startIndex"], peek=False)
+
+        self.max_op += 1
+        row = np.zeros(K.OP_FIELDS, np.int32)
+        row[K.K_KIND] = K.KIND_MARK
+        row[K.K_CTR] = self.max_op
+        row[K.K_ACT] = self._actor_int
+        row[K.K_MACTION] = 0 if input_op["action"] == "addMark" else 1
+        row[K.K_MTYPE] = MARK_TYPE_ID[mark_type]
+        row[K.K_MATTR] = self._uni.attrs.intern(input_op.get("attrs"))
+        row[K.K_SKIND] = 0  # start never grows (peritext.ts:466)
+        row[K.K_SCTR], row[K.K_SACT] = start
+
+        wire: Dict[str, Any] = {
+            "opId": make_op_id(self.max_op, self.actor_id),
+            "action": input_op["action"],
+            "obj": obj,
+            "start": {
+                "type": "before",
+                "elemId": make_op_id(start[0], self._uni.actors.actor(start[1])),
+            },
+            "markType": mark_type,
+        }
+        if end_grows and input_op["endIndex"] >= vis_len:
+            row[K.K_EKIND] = 2
+            wire["end"] = {"type": "endOfText"}
+        elif end_grows:
+            end = self._elem_id(input_op["endIndex"], peek=False)
+            row[K.K_EKIND] = 0
+            row[K.K_ECTR], row[K.K_EACT] = end
+            wire["end"] = {
+                "type": "before",
+                "elemId": make_op_id(end[0], self._uni.actors.actor(end[1])),
+            }
+        else:
+            end = self._elem_id(input_op["endIndex"] - 1, peek=False)
+            row[K.K_EKIND] = 1
+            row[K.K_ECTR], row[K.K_EACT] = end
+            wire["end"] = {
+                "type": "after",
+                "elemId": make_op_id(end[0], self._uni.actors.actor(end[1])),
+            }
+        if input_op.get("attrs"):
+            wire["attrs"] = dict(input_op["attrs"])
+        return row, wire
+
+    def _generate_root_op(self, change: Change, input_op: Dict[str, Any]) -> List[Patch]:
+        action = input_op["action"]
+        self.max_op += 1
+        op_id = make_op_id(self.max_op, self.actor_id)
+        key = input_op["key"]
+        wire: Dict[str, Any] = {"opId": op_id, "action": action, "key": key}
+        if action == "set":
+            wire["value"] = input_op["value"]
+        if action not in ("makeList", "makeMap", "set", "del"):
+            raise NotImplementedError(action)
+        change["ops"].append(wire)
+        took_effect = apply_root_op(self._uni.roots[0], wire)
+        if action == "makeList" and took_effect:
+            # Reference emits a makeList patch with hardcoded path
+            # (micromerge.ts:592).
+            return [{**wire, "path": ["text"]}]
+        return []
+
+    def _apply_rows(self, rows: List[np.ndarray]) -> List[Patch]:
+        if not rows:
+            return []
+        uni = self._uni
+        n_insert = sum(1 for r in rows if r[K.K_KIND] == K.KIND_INSERT)
+        n_mark = sum(1 for r in rows if r[K.K_KIND] == K.KIND_MARK)
+        uni.lengths[0] += n_insert
+        uni.mark_counts[0] += n_mark
+        uni._ensure_capacity(uni.lengths[0], uni.mark_counts[0])
+
+        op_rows = np.stack(rows)
+        state = self._state()
+        new_state, records = K.apply_ops_patched_jit(
+            state, jax.numpy.asarray(op_rows), jax.numpy.asarray(uni._ranks())
+        )
+        uni.states = stack_states([new_state])
+        records = {k: np.asarray(v)[None] for k, v in records.items()}
+        table = uni._mark_op_table(new_state)
+        return assemble_patches(records, 0, op_rows, table, uni.attrs)

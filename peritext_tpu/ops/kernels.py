@@ -659,6 +659,49 @@ cursor_elem_jit = jax.jit(cursor_elem)
 resolve_cursor_index_jit = jax.jit(resolve_cursor_index)
 
 
+def visible_elem_id(state: DocState, index: jax.Array, peek: jax.Array):
+    """Element id of the index-th visible element, with the optional
+    tombstone-peek rule for insert anchoring.
+
+    Reference getListElementId (micromerge.ts:762-805): with ``peek``, look
+    past the run of tombstones immediately following the target; if any of
+    them carries a markOpsAfter boundary, anchor on the *last* such tombstone
+    so new characters land after a non-growing span-end (motivating test:
+    test/micromerge.ts:520-566).  Also reproduces the reference's falsy-zero
+    quirk (micromerge.ts:794) — harmless here because the peek run starts
+    strictly after a visible element.
+    """
+    c = state.capacity
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < state.length
+    visible = live & ~state.deleted
+    rank = jnp.cumsum(visible.astype(jnp.int32)) - 1
+    match = visible & (rank == index)
+    i0 = jnp.argmax(match).astype(jnp.int32)
+    found = jnp.any(match)
+
+    first_vis_after = jnp.min(
+        jnp.where(visible & (ar > i0), ar, jnp.int32(c))
+    ).astype(jnp.int32)
+    after_def = state.bnd_def[1::2]
+    cand = live & state.deleted & (ar > i0) & (ar < first_vis_after) & after_def
+    j_peek = jnp.max(jnp.where(cand, ar, jnp.int32(-1)))
+    i = jnp.where(peek & (j_peek > 0), j_peek, i0)
+    return state.elem_ctr[i], state.elem_act[i], found
+
+
+visible_elem_id_jit = jax.jit(visible_elem_id)
+visible_elem_ids_batch = jax.jit(jax.vmap(visible_elem_id, in_axes=(None, 0, None)))
+
+
+def visible_length(state: DocState) -> jax.Array:
+    ar = jnp.arange(state.capacity, dtype=jnp.int32)
+    return jnp.sum((ar < state.length) & ~state.deleted).astype(jnp.int32)
+
+
+visible_length_jit = jax.jit(visible_length)
+
+
 def expand_mask_bits(mask: jax.Array, max_mark_ops: int) -> jax.Array:
     """[*, W] uint32 bitset rows -> [*, M] bool membership matrix."""
     m_idx = jnp.arange(max_mark_ops, dtype=jnp.int32)

@@ -43,6 +43,118 @@ from peritext_tpu.schema import ALL_MARKS
 Change = Dict[str, Any]
 
 
+def apply_root_op(root: Dict[str, Any], op: Dict[str, Any]) -> bool:
+    """Apply one structural op to a host root map with LWW by op id
+    (the oracle's map-key rule, micromerge.ts:578-602).  Returns whether the
+    op took effect."""
+    from peritext_tpu.ids import compare_op_ids
+
+    action = op["action"]
+    key = op.get("key")
+    key_ops = root.setdefault("__key_ops__", {})
+    stored = key_ops.get(key)
+    if stored is not None and compare_op_ids(stored, op["opId"]) != -1:
+        return False
+    key_ops[key] = op["opId"]
+    if action == "makeList":
+        root.setdefault("__lists__", {})[key] = op["opId"]
+    elif action == "makeMap":
+        root.setdefault("__maps__", {})[key] = op["opId"]
+    elif action == "set":
+        root[key] = op.get("value")
+    elif action == "del":
+        root.pop(key, None)
+    return True
+
+
+def assemble_patches(
+    records: Dict[str, np.ndarray],
+    r: int,
+    op_rows: np.ndarray,
+    table: Dict[str, Dict[str, Any]],
+    attrs: AttrRegistry,
+) -> List[Dict[str, Any]]:
+    """Reference-format patches from per-op device records (one replica)."""
+    patches: List[Dict[str, Any]] = []
+    op_ids = list(table)
+
+    def decode_mask(row: np.ndarray) -> Dict[str, Any]:
+        present = frozenset(
+            op_id for m, op_id in enumerate(op_ids) if row[m // 32] >> (m % 32) & 1
+        )
+        return ops_to_marks(present, table)
+
+    num_ops = records["kind"].shape[1]
+    for i in range(num_ops):
+        kind = int(records["kind"][r, i])
+        if kind == K.KIND_PAD or not records["valid"][r, i]:
+            continue
+        if kind == K.KIND_INSERT:
+            patches.append(
+                {
+                    "path": ["text"],
+                    "action": "insert",
+                    "index": int(records["index"][r, i]),
+                    "values": [chr(int(records["char"][r, i]))],
+                    "marks": decode_mask(records["ins_mask"][r, i]),
+                }
+            )
+        elif kind == K.KIND_DELETE:
+            patches.append(
+                {
+                    "path": ["text"],
+                    "action": "delete",
+                    "index": int(records["index"][r, i]),
+                    "count": 1,
+                }
+            )
+        elif kind == K.KIND_MARK:
+            patches.extend(assemble_mark_patches(records, r, i, op_rows[i], attrs))
+    return patches
+
+
+def assemble_mark_patches(
+    records: Dict[str, np.ndarray],
+    r: int,
+    i: int,
+    op_row: np.ndarray,
+    attrs: AttrRegistry,
+) -> List[Dict[str, Any]]:
+    """Reference peritext.ts:198-221: a patch opens at every written DURING
+    slot whose effective marks change, and closes at the next written slot
+    (or the end of the walk)."""
+    written = np.flatnonzero(records["written"][r, i])
+    if written.size == 0:
+        return []
+    during = records["during"][r, i]
+    changed = records["changed"][r, i]
+    vis = records["vis"][r, i]
+    obj_len = int(records["obj_len"][r, i])
+    action = "addMark" if int(op_row[K.K_MACTION]) == 0 else "removeMark"
+    mark_type = ALL_MARKS[int(op_row[K.K_MTYPE])]
+    attr_values = attrs.decode(int(op_row[K.K_MATTR]))
+
+    patches: List[Dict[str, Any]] = []
+    for j, p in enumerate(written):
+        if not (during[p] and changed[p]):
+            continue
+        start = int(vis[p])
+        end = int(vis[written[j + 1]]) if j + 1 < written.size else obj_len
+        # finishPartialPatch filters (peritext.ts:269-281).
+        if end > start and start < obj_len:
+            patch: Dict[str, Any] = {
+                "action": action,
+                "markType": mark_type,
+                "path": ["text"],
+                "startIndex": start,
+                "endIndex": min(end, obj_len),
+            }
+            if action == "addMark" and mark_type in ("link", "comment"):
+                patch["attrs"] = attr_values
+            patches.append(patch)
+    return patches
+
+
 class TpuUniverse:
     def __init__(
         self,
@@ -171,22 +283,14 @@ class TpuUniverse:
         """Structural map ops (makeList/makeMap/set/del on the root map).
 
         The device data plane is the text list; the tiny root-map control
-        plane lives here.  Only the conventional single text list is
-        supported as a list target (reference demos/tests only ever create
-        root.text, bridge.ts:24-27).
+        plane lives here, with the oracle's last-writer-wins-by-op-id rule
+        (micromerge.ts:578-602) so concurrent root-key writes converge.
+        Only the conventional single text list is supported as a list target
+        (reference demos/tests only ever create root.text, bridge.ts:24-27).
         """
         root = self.roots[r]
         for op in host_ops:
-            action = op["action"]
-            key = op.get("key")
-            if action == "makeList":
-                root.setdefault("__lists__", {})[key] = op["opId"]
-            elif action == "makeMap":
-                root.setdefault("__maps__", {})[key] = op["opId"]
-            elif action == "set":
-                root[key] = op.get("value")
-            elif action == "del":
-                root.pop(key, None)
+            apply_root_op(root, op)
 
     # -- patch-emitting ingestion (the incremental codepath) ----------------
 
@@ -232,98 +336,8 @@ class TpuUniverse:
         for r, name in enumerate(self.replica_ids):
             state = index_state(self.states, r)
             table = self._mark_op_table(state)
-            op_rows = ops[r]
-            out[name].extend(self._assemble_patches(records, r, op_rows, table))
+            out[name].extend(assemble_patches(records, r, ops[r], table, self.attrs))
         return out
-
-    def _assemble_patches(
-        self,
-        records: Dict[str, np.ndarray],
-        r: int,
-        op_rows: np.ndarray,
-        table: Dict[str, Dict[str, Any]],
-    ) -> List[Dict[str, Any]]:
-        """Reference-format patches from per-op device records."""
-        patches: List[Dict[str, Any]] = []
-        op_ids = list(table)
-
-        def decode_mask(row: np.ndarray) -> Dict[str, Any]:
-            present = frozenset(
-                op_id
-                for m, op_id in enumerate(op_ids)
-                if row[m // 32] >> (m % 32) & 1
-            )
-            return ops_to_marks(present, table)
-
-        num_ops = records["kind"].shape[1]
-        for i in range(num_ops):
-            kind = int(records["kind"][r, i])
-            if kind == K.KIND_PAD or not records["valid"][r, i]:
-                continue
-            if kind == K.KIND_INSERT:
-                patches.append(
-                    {
-                        "path": ["text"],
-                        "action": "insert",
-                        "index": int(records["index"][r, i]),
-                        "values": [chr(int(records["char"][r, i]))],
-                        "marks": decode_mask(records["ins_mask"][r, i]),
-                    }
-                )
-            elif kind == K.KIND_DELETE:
-                patches.append(
-                    {
-                        "path": ["text"],
-                        "action": "delete",
-                        "index": int(records["index"][r, i]),
-                        "count": 1,
-                    }
-                )
-            elif kind == K.KIND_MARK:
-                patches.extend(
-                    self._assemble_mark_patches(records, r, i, op_rows[i])
-                )
-        return patches
-
-    def _assemble_mark_patches(
-        self, records: Dict[str, np.ndarray], r: int, i: int, op_row: np.ndarray
-    ) -> List[Dict[str, Any]]:
-        """Reference peritext.ts:198-221: a patch opens at every written
-        DURING slot whose effective marks change, and closes at the next
-        written slot (or the end of the walk)."""
-        written = np.flatnonzero(records["written"][r, i])
-        if written.size == 0:
-            return []
-        during = records["during"][r, i]
-        changed = records["changed"][r, i]
-        vis = records["vis"][r, i]
-        obj_len = int(records["obj_len"][r, i])
-        action = "addMark" if int(op_row[K.K_MACTION]) == 0 else "removeMark"
-        mark_type = ALL_MARKS[int(op_row[K.K_MTYPE])]
-        attrs = self.attrs.decode(int(op_row[K.K_MATTR]))
-
-        patches: List[Dict[str, Any]] = []
-        for j, p in enumerate(written):
-            if not (during[p] and changed[p]):
-                continue
-            start = int(vis[p])
-            if j + 1 < written.size:
-                end = int(vis[written[j + 1]])
-            else:
-                end = obj_len
-            # finishPartialPatch filters (peritext.ts:269-281).
-            if end > start and start < obj_len:
-                patch: Dict[str, Any] = {
-                    "action": action,
-                    "markType": mark_type,
-                    "path": ["text"],
-                    "startIndex": start,
-                    "endIndex": min(end, obj_len),
-                }
-                if action == "addMark" and mark_type in ("link", "comment"):
-                    patch["attrs"] = attrs
-                patches.append(patch)
-        return patches
 
     # -- materialization ----------------------------------------------------
 

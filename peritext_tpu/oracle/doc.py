@@ -484,7 +484,13 @@ class Doc:
 
     def change(self, input_ops: Sequence[Dict[str, Any]]) -> Tuple[Change, List[Patch]]:
         deps = dict(self.clock)
-        self.seq += 1
+        # Resume from our own clock entry: a replica rebuilt by replaying a
+        # log containing its own past changes (the durability model, SURVEY
+        # §5) must not re-issue already-used sequence numbers — peers would
+        # silently drop the colliding change.  In every reference-exercised
+        # flow self.seq already equals clock[actor], so this is a no-op
+        # there (micromerge.ts:318 bumps seq unconditionally).
+        self.seq = max(self.seq, self.clock.get(self.actor_id, 0)) + 1
         self.clock[self.actor_id] = self.seq
 
         change: Change = {
