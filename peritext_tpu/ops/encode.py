@@ -224,6 +224,64 @@ def split_rows(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return rows[~is_mark], rows[is_mark]
 
 
+def fuse_insert_runs(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Fuse chained insert rows into KIND_INSERT_RUN rows + a char buffer.
+
+    A chain is consecutive rows where each insert references the previous
+    row's op id with consecutive counters from the same actor — exactly what
+    one insert input op expands to (micromerge.ts:351-361).  Chains apply as
+    one scan step each (see kernels._apply_text_op's contiguity argument).
+    Returns (fused rows, char buffer padded for in-bounds dynamic slices).
+    """
+    fused: List[np.ndarray] = []
+    chars: List[int] = []
+    i = 0
+    n = rows.shape[0]
+    while i < n:
+        row = rows[i]
+        if row[K.K_KIND] != K.KIND_INSERT:
+            fused.append(row)
+            i += 1
+            continue
+        j = i + 1
+        while (
+            j < n
+            and j - i < K.MAX_RUN_LEN
+            and rows[j][K.K_KIND] == K.KIND_INSERT
+            and rows[j][K.K_ACT] == rows[j - 1][K.K_ACT]
+            and rows[j][K.K_CTR] == rows[j - 1][K.K_CTR] + 1
+            and rows[j][K.K_REF_CTR] == rows[j - 1][K.K_CTR]
+            and rows[j][K.K_REF_ACT] == rows[j - 1][K.K_ACT]
+        ):
+            j += 1
+        if j - i == 1:
+            fused.append(row)
+        else:
+            run = np.zeros(K.OP_FIELDS, np.int32)
+            run[K.K_KIND] = K.KIND_INSERT_RUN
+            run[K.K_CTR] = row[K.K_CTR]
+            run[K.K_ACT] = row[K.K_ACT]
+            run[K.K_REF_CTR] = row[K.K_REF_CTR]
+            run[K.K_REF_ACT] = row[K.K_REF_ACT]
+            run[K.K_PAYLOAD] = len(chars)
+            run[K.K_RUN_LEN] = j - i
+            chars.extend(int(rows[p][K.K_PAYLOAD]) for p in range(i, j))
+            fused.append(run)
+        i = j
+    out_rows = np.stack(fused) if fused else np.zeros((0, K.OP_FIELDS), np.int32)
+    buf = np.zeros(len(chars) + K.MAX_RUN_LEN, np.int32)
+    buf[: len(chars)] = chars
+    return out_rows, buf
+
+
+def pad_buffer(buf: np.ndarray, length: int) -> np.ndarray:
+    if buf.shape[0] > length:
+        raise ValueError(f"char buffer of {buf.shape[0]} exceeds pad length {length}")
+    out = np.zeros(length, np.int32)
+    out[: buf.shape[0]] = buf
+    return out
+
+
 def pad_rows(rows: np.ndarray, length: int) -> np.ndarray:
     """Pad op rows with KIND_PAD to a fixed length."""
     if rows.shape[0] > length:

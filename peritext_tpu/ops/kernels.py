@@ -54,6 +54,12 @@ KIND_PAD = 0
 KIND_INSERT = 1
 KIND_DELETE = 2
 KIND_MARK = 3
+# Fast-path only: a fused run of chained inserts (see _apply_text_op).
+# Fields: K_CTR = first op counter, K_REF_* = the run's reference element,
+# K_PAYLOAD = offset into the side char buffer, K_RUN_LEN = run length.
+KIND_INSERT_RUN = 4
+K_RUN_LEN = K_MACTION  # field reuse; insert runs carry no mark fields
+MAX_RUN_LEN = 64
 
 
 def _find_elem(state: DocState, ctr, act):
@@ -415,37 +421,63 @@ apply_ops_patched_batch = jax.jit(jax.vmap(apply_ops_patched, in_axes=(0, 0, Non
 # interleaved apply_ops path instead.
 
 
-def _apply_text_op(carry, op, ranks):
+def _apply_text_op(carry, op, ranks, char_buf=None):
     """Insert/delete on the reduced text state (no boundary tables).
 
     carry = (elem_ctr, elem_act, deleted, chars, orig_idx, length).
     ``orig_idx`` tags each element with its pre-batch position (-1 for
     elements inserted by this batch) so the boundary tables can be permuted
     once at the end of the phase instead of shifted per insert.
+
+    With ``char_buf`` given, KIND_INSERT_RUN rows apply a whole chained
+    insert run (one input op's characters) in a single step.  Chains land
+    contiguously in the RGA order: the first op takes the normal position
+    (skip run included), and each subsequent op references the one before
+    it, whose successor — whatever originally followed the insertion point —
+    has a *smaller* id than the chain's first op (that is what ended the
+    skip run), hence smaller than every later chain op, so no further
+    skipping can occur.  Characters come from ``char_buf`` at
+    K_PAYLOAD..K_PAYLOAD+K_RUN_LEN; element counters are K_CTR..K_CTR+len-1.
     """
     elem_ctr, elem_act, deleted, chars, orig_idx, length = carry
     ar = jnp.arange(elem_ctr.shape[0], dtype=jnp.int32)
     live = ar < length
     is_insert = op[K_KIND] == KIND_INSERT
+    is_run = (op[K_KIND] == KIND_INSERT_RUN) if char_buf is not None else jnp.bool_(False)
     is_delete = op[K_KIND] == KIND_DELETE
 
     # Delete: tombstone the match.
     match = live & (elem_ctr == op[K_REF_CTR]) & (elem_act == op[K_REF_ACT])
     deleted_after_del = deleted | (match & is_delete)
 
-    # Insert: shared position rule, then masked-shift splice.
-    _, keep, here = _rga_insert_position(elem_ctr, elem_act, length, op, ranks)
+    # Insert: shared position rule, then masked-shift splice of k elements
+    # (k = 1 for plain inserts).
+    k = jnp.where(is_run, op[K_RUN_LEN], jnp.int32(1))
+    t, _, _ = _rga_insert_position(elem_ctr, elem_act, length, op, ranks)
+    keep = ar < t
+    block = (ar >= t) & (ar < t + k)
+    offset = ar - t  # position within the inserted block where `block`
+
+    if char_buf is not None:
+        run_chars = lax.dynamic_slice_in_dim(
+            char_buf, op[K_PAYLOAD] * is_run.astype(jnp.int32), MAX_RUN_LEN
+        )
+        block_chars = run_chars[jnp.clip(offset, 0, MAX_RUN_LEN - 1)]
+        char_vals = jnp.where(is_run, block_chars, op[K_PAYLOAD])
+    else:
+        char_vals = op[K_PAYLOAD]
 
     def splice(arr, value):
-        return jnp.where(keep, arr, jnp.where(here, value, jnp.roll(arr, 1)))
+        return jnp.where(keep, arr, jnp.where(block, value, jnp.roll(arr, k)))
 
+    any_insert = is_insert | is_run
     new_carry = (
-        jnp.where(is_insert, splice(elem_ctr, op[K_CTR]), elem_ctr),
-        jnp.where(is_insert, splice(elem_act, op[K_ACT]), elem_act),
-        jnp.where(is_insert, splice(deleted_after_del, False), deleted_after_del),
-        jnp.where(is_insert, splice(chars, op[K_PAYLOAD]), chars),
-        jnp.where(is_insert, splice(orig_idx, jnp.int32(-1)), orig_idx),
-        length + is_insert.astype(jnp.int32),
+        jnp.where(any_insert, splice(elem_ctr, op[K_CTR] + offset), elem_ctr),
+        jnp.where(any_insert, splice(elem_act, op[K_ACT]), elem_act),
+        jnp.where(any_insert, splice(deleted_after_del, False), deleted_after_del),
+        jnp.where(any_insert, splice(chars, char_vals), chars),
+        jnp.where(any_insert, splice(orig_idx, jnp.int32(-1)), orig_idx),
+        length + jnp.where(any_insert, k, 0),
     )
     return new_carry, None
 
@@ -542,19 +574,27 @@ def _apply_mark_fast(carry, op, elem_ctr, elem_act, length):
     return new_carry, None
 
 
-def merge_step(state: DocState, text_ops: jax.Array, mark_ops: jax.Array, ranks: jax.Array) -> DocState:
+def merge_step(
+    state: DocState,
+    text_ops: jax.Array,
+    mark_ops: jax.Array,
+    ranks: jax.Array,
+    char_buf: jax.Array | None = None,
+) -> DocState:
     """Fast batched merge: text phase -> boundary permute -> mark phase.
 
     The production remote-ingestion path (no patch emission).  ``text_ops``
     holds the batch's inserts/deletes in causal order, ``mark_ops`` its mark
-    ops in causal order; both padded with KIND_PAD rows.
+    ops in causal order; both padded with KIND_PAD rows.  With ``char_buf``,
+    text rows may be fused KIND_INSERT_RUN rows (encode.fuse_insert_runs),
+    applying a whole typing run per scan step.
     """
     c = state.capacity
     orig_idx = jnp.arange(c, dtype=jnp.int32)
 
     text_carry = (state.elem_ctr, state.elem_act, state.deleted, state.chars, orig_idx, state.length)
     (elem_ctr, elem_act, deleted, chars, orig_idx, length), _ = lax.scan(
-        lambda cry, op: _apply_text_op(cry, op, ranks), text_carry, text_ops
+        lambda cry, op: _apply_text_op(cry, op, ranks, char_buf), text_carry, text_ops
     )
     bnd_def, bnd_mask = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
 
@@ -593,6 +633,8 @@ def merge_step(state: DocState, text_ops: jax.Array, mark_ops: jax.Array, ranks:
 
 merge_step_vmapped = jax.vmap(merge_step, in_axes=(0, 0, 0, None))
 merge_step_batch = jax.jit(merge_step_vmapped)
+merge_step_fused_vmapped = jax.vmap(merge_step, in_axes=(0, 0, 0, None, 0))
+merge_step_fused_batch = jax.jit(merge_step_fused_vmapped)
 
 
 def flatten_sources(state: DocState):

@@ -26,6 +26,8 @@ from peritext_tpu.ops.encode import (
     AttrRegistry,
     bucket_length,
     encode_changes,
+    fuse_insert_runs,
+    pad_buffer,
     pad_rows,
     split_rows,
 )
@@ -251,32 +253,41 @@ class TpuUniverse:
 
         text_batches: List[np.ndarray] = []
         mark_batches: List[np.ndarray] = []
-        max_text = max_mark = 0
+        char_bufs: List[np.ndarray] = []
+        max_text = max_mark = max_buf = 0
+        any_rows = False
         for r, changes in enumerate(batches):
             ordered = self._gate(r, changes)
             rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
             self._apply_host_ops(r, host_ops)
             self.lengths[r] += counts["insert"]
             self.mark_counts[r] += counts["mark"]
+            any_rows = any_rows or rows.shape[0] > 0
             text_rows, mark_rows = split_rows(rows)
+            text_rows, char_buf = fuse_insert_runs(text_rows)
             text_batches.append(text_rows)
             mark_batches.append(mark_rows)
+            char_bufs.append(char_buf)
             max_text = max(max_text, text_rows.shape[0])
             max_mark = max(max_mark, mark_rows.shape[0])
+            max_buf = max(max_buf, char_buf.shape[0])
 
         self._ensure_capacity(max(self.lengths, default=0), max(self.mark_counts, default=0))
-        if max_text == 0 and max_mark == 0:
+        if not any_rows:
             return
         text_pad = bucket_length(max(max_text, 1))
         mark_pad = bucket_length(max(max_mark, 1))
+        buf_pad = bucket_length(max(max_buf, K.MAX_RUN_LEN))
         text_ops = np.stack([pad_rows(rows, text_pad) for rows in text_batches])
         mark_ops = np.stack([pad_rows(rows, mark_pad) for rows in mark_batches])
+        bufs = np.stack([pad_buffer(buf, buf_pad) for buf in char_bufs])
         ranks = self._ranks()
-        self.states = K.merge_step_batch(
+        self.states = K.merge_step_fused_batch(
             self.states,
             jax.numpy.asarray(text_ops),
             jax.numpy.asarray(mark_ops),
             jax.numpy.asarray(ranks),
+            jax.numpy.asarray(bufs),
         )
 
     def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:

@@ -1,0 +1,113 @@
+"""Insert-run fusion: fused application must equal per-op application."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from peritext_tpu.ids import ActorRegistry
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.encode import AttrRegistry, encode_changes, fuse_insert_runs, split_rows
+from peritext_tpu.ops.state import make_empty_state, stack_states
+from peritext_tpu.oracle import Doc
+from peritext_tpu.testing import generate_docs
+
+
+def encode_stream(changes):
+    actors, attrs = ActorRegistry(), AttrRegistry()
+    rows, _, _ = encode_changes(changes, actors, attrs)
+    return rows, actors
+
+
+def test_typing_run_fuses_to_one_row():
+    doc = Doc("a")
+    doc.change([{"path": [], "action": "makeList", "key": "text"}])
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": list("hello world")}]
+    )
+    rows, _ = encode_stream([change])
+    fused, buf = fuse_insert_runs(rows)
+    assert rows.shape[0] == 11
+    assert fused.shape[0] == 1
+    assert fused[0][K.K_KIND] == K.KIND_INSERT_RUN
+    assert fused[0][K.K_RUN_LEN] == 11
+    assert [chr(c) for c in buf[:11]] == list("hello world")
+
+
+def test_long_run_splits_at_cap():
+    doc = Doc("a")
+    doc.change([{"path": [], "action": "makeList", "key": "text"}])
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["x"] * 150}]
+    )
+    rows, _ = encode_stream([change])
+    fused, _ = fuse_insert_runs(rows)
+    kinds = fused[:, K.K_KIND].tolist()
+    lens = fused[:, K.K_RUN_LEN].tolist()
+    assert kinds.count(K.KIND_INSERT_RUN) == 3
+    assert sum(l for k, l in zip(kinds, lens) if k == K.KIND_INSERT_RUN) == 150
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fused_matches_per_op(seed):
+    """Random concurrent histories: fused fast path == per-op fast path."""
+    import random
+
+    rng = random.Random(seed)
+    docs, _, genesis = generate_docs("base text", 2)
+    stream = [genesis]
+    for _ in range(12):
+        doc = docs[rng.randrange(2)]
+        length = len(doc.root["text"])
+        kind = rng.choice(["insert", "insert", "delete", "mark"])
+        if kind == "insert":
+            op = {
+                "path": ["text"],
+                "action": "insert",
+                "index": rng.randrange(length + 1) if length else 0,
+                "values": list("abcdef"[: rng.randrange(1, 6)]),
+            }
+        elif kind == "delete" and length > 2:
+            idx = rng.randrange(length - 1)
+            op = {"path": ["text"], "action": "delete", "index": idx, "count": rng.randrange(1, min(3, length - idx) + 1)}
+        else:
+            start = rng.randrange(max(length - 1, 1))
+            op = {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": start,
+                "endIndex": min(start + rng.randrange(1, 5), length),
+                "markType": rng.choice(["strong", "link"]),
+            }
+            if op["markType"] == "link":
+                op["attrs"] = {"url": "u.example"}
+            if op["endIndex"] <= op["startIndex"]:
+                continue
+        change, _ = doc.change([op])
+        stream.append(change)
+        other = docs[1 - docs.index(doc)]
+        other.apply_change(change)
+
+    rows, actors = encode_stream(stream)
+    text_rows, mark_rows = split_rows(rows)
+    fused_rows, buf = fuse_insert_runs(text_rows)
+    assert fused_rows.shape[0] < text_rows.shape[0]  # fusion happened
+
+    ranks = np.zeros(8, np.int32)
+    rk = actors.ranks()
+    ranks[: len(rk)] = rk
+    base = stack_states([make_empty_state(256, 64)])
+
+    def pad(rows):
+        out = np.zeros((1, max(rows.shape[0], 1), K.OP_FIELDS), np.int32)
+        out[0, : rows.shape[0]] = rows
+        return jnp.asarray(out)
+
+    plain = K.merge_step_batch(base, pad(text_rows), pad(mark_rows), jnp.asarray(ranks))
+    fused = K.merge_step_fused_batch(
+        base, pad(fused_rows), pad(mark_rows), jnp.asarray(ranks), jnp.asarray(buf[None])
+    )
+    import dataclasses
+
+    for field in dataclasses.fields(plain):
+        a = np.asarray(getattr(plain, field.name))
+        b = np.asarray(getattr(fused, field.name))
+        assert (a == b).all(), f"seed {seed}: field {field.name} diverged"
