@@ -14,7 +14,17 @@ Layers:
                               checkpointing)
 """
 from peritext_tpu.oracle import Doc, accumulate_patches
-from peritext_tpu.schema import ALL_MARKS, MARK_SPEC, MARK_TYPE_ID
+from peritext_tpu.schema import MARK_SPEC, MARK_TYPE_ID, register_mark_type
+
+
+def __getattr__(name):
+    # ALL_MARKS is rebound when mark types register; forward dynamically so
+    # `peritext_tpu.ALL_MARKS` is never stale.
+    if name == "ALL_MARKS":
+        from peritext_tpu import schema
+
+        return schema.ALL_MARKS
+    raise AttributeError(name)
 
 __version__ = "0.1.0"
 
@@ -22,6 +32,7 @@ __all__ = [
     "Doc",
     "accumulate_patches",
     "ALL_MARKS",
+    "register_mark_type",
     "MARK_SPEC",
     "MARK_TYPE_ID",
     "__version__",

@@ -30,7 +30,7 @@ from peritext_tpu.ids import make_op_id
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.state import index_state, stack_states
 from peritext_tpu.ops.universe import TpuUniverse, apply_root_op, assemble_patches
-from peritext_tpu.schema import MARK_SPEC, MARK_TYPE_ID
+from peritext_tpu.schema import MARK_SPEC, MARK_TYPE_ID, allow_multiple_array
 
 Change = Dict[str, Any]
 Patch = Dict[str, Any]
@@ -280,7 +280,10 @@ class TpuDoc:
         op_rows = np.stack(rows)
         state = self._state()
         new_state, records = K.apply_ops_patched_jit(
-            state, jax.numpy.asarray(op_rows), jax.numpy.asarray(uni._ranks())
+            state,
+            jax.numpy.asarray(op_rows),
+            jax.numpy.asarray(uni._ranks()),
+            jax.numpy.asarray(allow_multiple_array()),
         )
         uni.states = stack_states([new_state])
         records = {k: np.asarray(v)[None] for k, v in records.items()}

@@ -123,8 +123,8 @@ def decode_internal_op(
     log envelope does).  Round-trip fidelity is tested in
     tests/test_native_codec.py.
     """
+    from peritext_tpu import schema
     from peritext_tpu.ids import make_op_id
-    from peritext_tpu.schema import ALL_MARKS
 
     op_id = make_op_id(int(row[K.K_CTR]), actors.actor(int(row[K.K_ACT])))
     kind = int(row[K.K_KIND])
@@ -163,7 +163,7 @@ def decode_internal_op(
                     int(row[K.K_SCTR]), actors.actor(int(row[K.K_SACT]))
                 ),
             },
-            "markType": ALL_MARKS[int(row[K.K_MTYPE])],
+            "markType": schema.ALL_MARKS[int(row[K.K_MTYPE])],
         }
         if int(row[K.K_EKIND]) == 2:
             op["end"] = {"type": "endOfText"}

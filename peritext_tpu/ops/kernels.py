@@ -28,9 +28,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from peritext_tpu.ops.state import MASK_WORD_BITS, DocState
-from peritext_tpu.schema import ALLOW_MULTIPLE_BY_ID
-
-ALLOW_MULTIPLE_ARR = tuple(bool(x) for x in ALLOW_MULTIPLE_BY_ID)
+# Mark-type allowMultiple flags arrive as a runtime input vector
+# (schema.allow_multiple_array) so registered mark types take effect
+# without stale jit constants.
 
 # Op-row field indices (see encode.py for the host-side encoder).
 K_KIND = 0  # 0 pad, 1 insert, 2 delete, 3 mark
@@ -269,7 +269,7 @@ apply_ops_batch = jax.jit(apply_ops_vmapped)
 # ---------------------------------------------------------------------------
 
 
-def _mark_patch_signals(state: DocState, op, ranks):
+def _mark_patch_signals(state: DocState, op, ranks, multi):
     """Per-slot patch signals for a mark op (reference peritext.ts:181-214).
 
     Returns (written, during, changed, vis, final_vis):
@@ -305,7 +305,7 @@ def _mark_patch_signals(state: DocState, op, ranks):
 
     # Winner of the op's own resolution group per slot.
     m_live = jnp.arange(state.max_mark_ops, dtype=jnp.int32) < state.mark_count
-    is_multi = jnp.asarray(ALLOW_MULTIPLE_ARR)[op[K_MTYPE]]
+    is_multi = multi[op[K_MTYPE]]
     group = m_live & (state.mark_type == op[K_MTYPE]) & (
         ~is_multi | (state.mark_attr == op[K_MATTR])
     )
@@ -340,7 +340,7 @@ def _mark_patch_signals(state: DocState, op, ranks):
     return written, during, changed, vis, final_vis
 
 
-def apply_op_patched(state: DocState, op: jax.Array, ranks: jax.Array):
+def apply_op_patched(state: DocState, op: jax.Array, ranks: jax.Array, multi: jax.Array):
     """Faithful per-op application + a fixed-shape patch record.
 
     The record feeds host-side patch assembly (universe.assemble_patches),
@@ -375,7 +375,7 @@ def apply_op_patched(state: DocState, op: jax.Array, ranks: jax.Array):
     del_valid = jnp.any(d_match) & ~state.deleted[d_idx]
     del_index = jnp.sum(visible & (ar < d_idx)).astype(jnp.int32)
 
-    written, during, changed, vis, final_vis = _mark_patch_signals(state, op, ranks)
+    written, during, changed, vis, final_vis = _mark_patch_signals(state, op, ranks, multi)
 
     record = {
         "kind": kind,
@@ -393,15 +393,15 @@ def apply_op_patched(state: DocState, op: jax.Array, ranks: jax.Array):
     return new_state, record
 
 
-def apply_ops_patched(state: DocState, ops: jax.Array, ranks: jax.Array):
+def apply_ops_patched(state: DocState, ops: jax.Array, ranks: jax.Array, multi: jax.Array):
     def step(s, op):
-        return apply_op_patched(s, op, ranks)
+        return apply_op_patched(s, op, ranks, multi)
 
     return lax.scan(step, state, ops)
 
 
 apply_ops_patched_jit = jax.jit(apply_ops_patched)
-apply_ops_patched_batch = jax.jit(jax.vmap(apply_ops_patched, in_axes=(0, 0, None)))
+apply_ops_patched_batch = jax.jit(jax.vmap(apply_ops_patched, in_axes=(0, 0, None, None)))
 
 
 # ---------------------------------------------------------------------------
@@ -751,7 +751,7 @@ def expand_mask_bits(mask: jax.Array, max_mark_ops: int) -> jax.Array:
     return ((words >> (m_idx % MASK_WORD_BITS).astype(jnp.uint32)) & 1).astype(bool)
 
 
-def resolve_winners(state: DocState, present: jax.Array, ranks: jax.Array) -> jax.Array:
+def resolve_winners(state: DocState, present: jax.Array, ranks: jax.Array, multi: jax.Array) -> jax.Array:
     """LWW/multiset resolution of mark-op sets (reference opsToMarks,
     peritext.ts:294-326), as a dominance matmul.
 
@@ -766,7 +766,7 @@ def resolve_winners(state: DocState, present: jax.Array, ranks: jax.Array) -> ja
     with action addMark activates (type, attrs); a removeMark winner means
     the mark is absent.
     """
-    is_multi = jnp.asarray(ALLOW_MULTIPLE_ARR)[state.mark_type]
+    is_multi = multi[state.mark_type]
     same_type = state.mark_type[:, None] == state.mark_type[None, :]
     same_attr = state.mark_attr[:, None] == state.mark_attr[None, :]
     same_group = same_type & (~is_multi[:, None] | same_attr)
@@ -783,7 +783,7 @@ def resolve_winners(state: DocState, present: jax.Array, ranks: jax.Array) -> ja
     return present & (dom_count < 0.5) & m_live[None, :]
 
 
-def convergence_digest(state: DocState, ranks: jax.Array) -> jax.Array:
+def convergence_digest(state: DocState, ranks: jax.Array, multi: jax.Array) -> jax.Array:
     """Order-sensitive checksum of the visible document + resolved marks.
 
     The TPU-native analog of the fuzzer's cross-replica convergence asserts
@@ -801,7 +801,7 @@ def convergence_digest(state: DocState, ranks: jax.Array) -> jax.Array:
     vis_rank = (jnp.cumsum(liveu) - liveu) * liveu  # 0-based visible index
     mask, _ = flatten_sources(state)
     present = expand_mask_bits(mask, state.max_mark_ops)
-    winners = resolve_winners(state, present, ranks)
+    winners = resolve_winners(state, present, ranks, multi)
     adds = winners & (state.mark_action[None, :] == 0)
     mark_value = (
         state.mark_type.astype(jnp.uint32) * jnp.uint32(1000003)
@@ -815,4 +815,4 @@ def convergence_digest(state: DocState, ranks: jax.Array) -> jax.Array:
     return jnp.uint32(2166136261) ^ char_mix ^ (mark_mix * jnp.uint32(31))
 
 
-convergence_digest_batch = jax.jit(jax.vmap(convergence_digest, in_axes=(0, None)))
+convergence_digest_batch = jax.jit(jax.vmap(convergence_digest, in_axes=(0, None, None)))

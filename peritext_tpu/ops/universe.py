@@ -40,7 +40,8 @@ from peritext_tpu.ops.state import (
 )
 from peritext_tpu.oracle.doc import add_characters_to_spans, ops_to_marks
 from peritext_tpu.runtime.sync import causal_order
-from peritext_tpu.schema import ALL_MARKS
+from peritext_tpu import schema
+from peritext_tpu.schema import allow_multiple_array
 
 Change = Dict[str, Any]
 
@@ -133,7 +134,7 @@ def assemble_mark_patches(
     vis = records["vis"][r, i]
     obj_len = int(records["obj_len"][r, i])
     action = "addMark" if int(op_row[K.K_MACTION]) == 0 else "removeMark"
-    mark_type = ALL_MARKS[int(op_row[K.K_MTYPE])]
+    mark_type = schema.ALL_MARKS[int(op_row[K.K_MTYPE])]
     attr_values = attrs.decode(int(op_row[K.K_MATTR]))
 
     patches: List[Dict[str, Any]] = []
@@ -341,7 +342,10 @@ class TpuUniverse:
         ops = np.stack([pad_rows(rows, pad) for rows in encoded])
         ranks = self._ranks()
         self.states, records = K.apply_ops_patched_batch(
-            self.states, jax.numpy.asarray(ops), jax.numpy.asarray(ranks)
+            self.states,
+            jax.numpy.asarray(ops),
+            jax.numpy.asarray(ranks),
+            jax.numpy.asarray(allow_multiple_array()),
         )
         records = {k: np.asarray(v) for k, v in records.items()}
         for r, name in enumerate(self.replica_ids):
@@ -365,7 +369,7 @@ class TpuUniverse:
             op: Dict[str, Any] = {
                 "opId": op_id,
                 "action": "addMark" if action[m] == 0 else "removeMark",
-                "markType": ALL_MARKS[int(mtype[m])],
+                "markType": schema.ALL_MARKS[int(mtype[m])],
             }
             attrs = self.attrs.decode(int(attr[m]))
             if attrs is not None:
@@ -433,7 +437,8 @@ class TpuUniverse:
     def digests(self) -> np.ndarray:
         """Per-replica convergence digests in one batched device call."""
         ranks = jax.numpy.asarray(self._ranks())
-        return np.asarray(K.convergence_digest_batch(self.states, ranks))
+        multi = jax.numpy.asarray(allow_multiple_array())
+        return np.asarray(K.convergence_digest_batch(self.states, ranks, multi))
 
     def get_cursor(self, replica: str | int, index: int) -> Dict[str, Any]:
         """Stable cursor for a visible index (reference micromerge.ts:465-472)."""

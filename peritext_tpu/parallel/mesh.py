@@ -76,7 +76,7 @@ def shard_states(states: DocState, mesh: Mesh, shard_seq: bool = True) -> DocSta
     return jax.tree.map(jax.device_put, states, shardings)
 
 
-def _apply_and_digest(states: DocState, text_ops: jax.Array, mark_ops: jax.Array, ranks: jax.Array):
+def _apply_and_digest(states: DocState, text_ops: jax.Array, mark_ops: jax.Array, ranks: jax.Array, multi: jax.Array):
     """One full sharded step: batched fast merge + global convergence.
 
     The jnp.sum over per-replica digests lowers to an all-reduce across the
@@ -84,7 +84,7 @@ def _apply_and_digest(states: DocState, text_ops: jax.Array, mark_ops: jax.Array
     carry/argmax collectives from GSPMD.
     """
     new_states = K.merge_step_vmapped(states, text_ops, mark_ops, ranks)
-    digests = jax.vmap(K.convergence_digest, in_axes=(0, None))(new_states, ranks)
+    digests = jax.vmap(K.convergence_digest, in_axes=(0, None, None))(new_states, ranks, multi)
     global_digest = jnp.sum(digests)
     return new_states, digests, global_digest
 
@@ -97,7 +97,7 @@ def sharded_apply(mesh: Mesh, shard_seq: bool = True):
     digest_shard = NamedSharding(mesh, P("replica"))
     return jax.jit(
         _apply_and_digest,
-        in_shardings=(st_shard, ops_shard, ops_shard, ranks_shard),
+        in_shardings=(st_shard, ops_shard, ops_shard, ranks_shard, ranks_shard),
         out_shardings=(st_shard, digest_shard, NamedSharding(mesh, P())),
     )
 
@@ -106,12 +106,12 @@ def sharded_digest_reduce(mesh: Mesh, shard_seq: bool = True):
     """Batched digest computation + global reduce under mesh shardings."""
     st_shard = state_sharding(mesh, shard_seq)
 
-    def f(states: DocState, ranks: jax.Array):
-        digests = jax.vmap(K.convergence_digest, in_axes=(0, None))(states, ranks)
+    def f(states: DocState, ranks: jax.Array, multi: jax.Array):
+        digests = jax.vmap(K.convergence_digest, in_axes=(0, None, None))(states, ranks, multi)
         return digests, jnp.sum(digests)
 
     return jax.jit(
         f,
-        in_shardings=(st_shard, NamedSharding(mesh, P())),
+        in_shardings=(st_shard, NamedSharding(mesh, P()), NamedSharding(mesh, P())),
         out_shardings=(NamedSharding(mesh, P("replica")), NamedSharding(mesh, P())),
     )

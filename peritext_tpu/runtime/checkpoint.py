@@ -84,6 +84,78 @@ def load_universe(path: str) -> TpuUniverse:
     return uni
 
 
+class CheckpointManager:
+    """Rotating snapshot schedule: save every ``interval`` steps, keep the
+    newest ``keep`` snapshots, resume from the newest loadable one.
+
+    Snapshots are written atomically (save_universe), so a crash mid-save
+    leaves the previous generation intact; ``latest`` is derived from the
+    on-disk generation numbers rather than a pointer file.
+    """
+
+    def __init__(self, directory: str, interval: int = 1, keep: int = 3) -> None:
+        self.directory = directory
+        self.interval = max(1, interval)
+        self.keep = max(1, keep)
+        self._step = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, generation: int) -> str:
+        return os.path.join(self.directory, f"snap-{generation:08d}")
+
+    def generations(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("snap-") and name.endswith(".json"):
+                try:
+                    out.append(int(name[5:-5]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def maybe_save(self, uni: TpuUniverse) -> Optional[str]:
+        """Call once per ingest step; saves on the schedule and prunes."""
+        self._step += 1
+        if self._step % self.interval != 0:
+            return None
+        return self.save(uni)
+
+    def save(self, uni: TpuUniverse) -> str:
+        gens = self.generations()
+        generation = (gens[-1] + 1) if gens else 0
+        path = self._path(generation)
+        save_universe(uni, path)
+        for old in self.generations()[: -self.keep]:
+            for suffix in (".json", ".npz"):
+                try:
+                    os.remove(self._path(old) + suffix)
+                except OSError:
+                    pass
+        return path
+
+    def restore_latest(self, log: Any = None) -> Optional[TpuUniverse]:
+        """Newest loadable snapshot (+ optional log-tail replay), or None.
+
+        Only snapshot-load failures fall back a generation; errors during
+        log-tail replay indicate a log problem and propagate.
+        """
+        import zipfile
+
+        for generation in reversed(self.generations()):
+            try:
+                uni = load_universe(self._path(generation))
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+                continue  # corrupt/partial snapshot: fall back a generation
+            if log is not None:
+                batches = {
+                    name: log.missing_changes(log.clock(), uni.clock(name))
+                    for name in uni.replica_ids
+                }
+                uni.apply_changes(batches)
+            return uni
+        return None
+
+
 def resume_universe(
     path: str, log: Any, replicas: Optional[List[str]] = None
 ) -> TpuUniverse:

@@ -54,6 +54,41 @@ def test_resume_replays_log_tail(tmp_path):
     assert d[0] == d[1]
 
 
+def test_checkpoint_manager_rotation_and_restore(tmp_path):
+    from peritext_tpu.runtime.checkpoint import CheckpointManager
+
+    docs, log, uni = build_session(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), interval=2, keep=2)
+    assert mgr.maybe_save(uni) is None  # step 1: off-schedule
+    assert mgr.maybe_save(uni) is not None  # step 2: saved
+    for _ in range(4):
+        mgr.maybe_save(uni)
+    assert len(mgr.generations()) == 2  # pruned to keep=2
+
+    c2, _ = docs[0].change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["!"]}]
+    )
+    log.record(c2)
+    restored = mgr.restore_latest(log)
+    assert restored is not None
+    assert restored.text("doc1").startswith("!")
+
+
+def test_checkpoint_manager_skips_corrupt_generation(tmp_path):
+    from peritext_tpu.runtime.checkpoint import CheckpointManager
+
+    _, log, uni = build_session(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    mgr.save(uni)
+    good_spans = uni.spans("doc1")
+    path = mgr.save(uni)
+    with open(path + ".npz", "wb") as f:
+        f.write(b"corrupt")  # newest snapshot damaged
+    restored = mgr.restore_latest()
+    assert restored is not None
+    assert restored.spans("doc1") == good_spans
+
+
 def test_log_only_cold_rebuild_matches_snapshot(tmp_path):
     """The log alone reconstructs the same state as snapshot+tail (the
     reference durability model: state == replayed change log)."""

@@ -1,0 +1,86 @@
+"""Runtime mark-schema extension (the reference demoMarkSpec pattern)."""
+import pytest
+
+from peritext_tpu import schema
+from peritext_tpu.ops import TpuDoc
+from peritext_tpu.oracle import Doc
+from peritext_tpu.testing import generate_docs
+
+
+@pytest.fixture(autouse=True)
+def registered_highlight():
+    schema.register_mark_type("highlightChange", inclusive=False, allow_multiple=False)
+    yield
+    # Registration is append-only by design; later tests are unaffected
+    # because op encoding is by name -> id lookup.
+
+
+def test_register_is_idempotent_and_conflict_checked():
+    schema.register_mark_type("highlightChange", inclusive=False, allow_multiple=False)
+    with pytest.raises(ValueError, match="different flags"):
+        schema.register_mark_type("highlightChange", inclusive=True)
+
+
+def test_registered_mark_round_trips_both_engines():
+    docs, _, genesis = generate_docs("flash me")
+    doc1, _ = docs
+    change, _ = doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 5,
+                "markType": "highlightChange",
+            }
+        ]
+    )
+    expected = [
+        {"marks": {"highlightChange": {"active": True}}, "text": "flash"},
+        {"marks": {}, "text": " me"},
+    ]
+    assert doc1.get_text_with_formatting(["text"]) == expected
+
+    tpu = TpuDoc("viewer")
+    tpu.apply_change(genesis)
+    tpu.apply_change(change)
+    assert tpu.get_text_with_formatting(["text"]) == expected
+
+    # Non-inclusive: typing at the right edge must not grow the highlight.
+    for doc in (doc1, tpu):
+        doc.change([{"path": ["text"], "action": "insert", "index": 5, "values": ["!"]}])
+        spans = doc.get_text_with_formatting(["text"])
+        assert spans[0]["text"] == "flash"
+        assert spans[1]["text"].startswith("!")
+
+
+def test_registered_mark_generation_on_device():
+    tpu = TpuDoc("a")
+    tpu.change([{"path": [], "action": "makeList", "key": "text"}])
+    tpu.change([{"path": ["text"], "action": "insert", "index": 0, "values": list("xy")}])
+    tpu.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 2,
+                "markType": "highlightChange",
+            }
+        ]
+    )
+    oracle = Doc("a")
+    oracle.change([{"path": [], "action": "makeList", "key": "text"}])
+    oracle.change([{"path": ["text"], "action": "insert", "index": 0, "values": list("xy")}])
+    oracle.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 2,
+                "markType": "highlightChange",
+            }
+        ]
+    )
+    assert tpu.get_text_with_formatting(["text"]) == oracle.get_text_with_formatting(["text"])

@@ -24,19 +24,22 @@ def batch():
 def test_sharded_merge_matches_single_device(batch, mesh_shape):
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
+    from peritext_tpu.schema import allow_multiple_array
+
     text_ops = jnp.asarray(batch["text_ops"])
     mark_ops = jnp.asarray(batch["mark_ops"])
     ranks = jnp.asarray(batch["ranks"])
+    multi = jnp.asarray(allow_multiple_array())
 
     ref = K.merge_step_batch(batch["states"], text_ops, mark_ops, ranks)
     ref_digests = np.asarray(
-        jax.vmap(K.convergence_digest, in_axes=(0, None))(ref, ranks)
+        jax.vmap(K.convergence_digest, in_axes=(0, None, None))(ref, ranks, multi)
     )
 
     mesh = make_mesh(jax.devices()[:8], *mesh_shape)
     states = shard_states(batch["states"], mesh)
     step = sharded_apply(mesh)
-    out, digests, global_digest = step(states, text_ops, mark_ops, ranks)
+    out, digests, global_digest = step(states, text_ops, mark_ops, ranks, multi)
 
     for field in dataclasses.fields(ref):
         a = np.asarray(getattr(ref, field.name))
