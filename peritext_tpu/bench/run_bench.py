@@ -1,0 +1,44 @@
+"""Benchmark runner subprocess: measures and prints the one JSON line.
+
+Invoked by bench.py (possibly with PERITEXT_BENCH_PLATFORM=cpu as a fallback
+when the TPU tunnel is unreachable — bench.py supervises with a timeout).
+"""
+import json
+import os
+import sys
+
+
+def main() -> None:
+    platform = os.environ.get("PERITEXT_BENCH_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    num_replicas = int(os.environ.get("BENCH_REPLICAS", "1024"))
+    doc_len = int(os.environ.get("BENCH_DOC_LEN", "1000"))
+    ops_per_merge = int(os.environ.get("BENCH_OPS", "64"))
+
+    from peritext_tpu.bench.workloads import time_batched_merge, time_scalar_baseline
+
+    tpu = time_batched_merge(
+        num_replicas=num_replicas, doc_len=doc_len, ops_per_merge=ops_per_merge
+    )
+    scalar = time_scalar_baseline(doc_len=doc_len, ops_per_merge=ops_per_merge)
+
+    import jax
+
+    result = {
+        "metric": "merged_crdt_ops_per_sec_batched_replicas",
+        "value": round(tpu["ops_per_sec"], 1),
+        "unit": "ops/s",
+        "vs_baseline": round(tpu["ops_per_sec"] / scalar["ops_per_sec"], 2),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    main()

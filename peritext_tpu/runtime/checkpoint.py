@@ -147,13 +147,17 @@ class CheckpointManager:
             except (OSError, ValueError, KeyError, zipfile.BadZipFile):
                 continue  # corrupt/partial snapshot: fall back a generation
             if log is not None:
-                batches = {
-                    name: log.missing_changes(log.clock(), uni.clock(name))
-                    for name in uni.replica_ids
-                }
-                uni.apply_changes(batches)
+                _replay_tail(uni, log)
             return uni
         return None
+
+
+def _replay_tail(uni: TpuUniverse, log: Any, replicas: Optional[List[str]] = None) -> None:
+    frontier = log.clock()
+    batches: Dict[str, List[Dict[str, Any]]] = {}
+    for name in replicas or uni.replica_ids:
+        batches[name] = log.missing_changes(frontier, uni.clock(name))
+    uni.apply_changes(batches)
 
 
 def resume_universe(
@@ -166,9 +170,5 @@ def resume_universe(
     log's frontier; this is the crash-recovery path.
     """
     uni = load_universe(path)
-    frontier = log.clock()
-    batches: Dict[str, List[Dict[str, Any]]] = {}
-    for name in replicas or uni.replica_ids:
-        batches[name] = log.missing_changes(frontier, uni.clock(name))
-    uni.apply_changes(batches)
+    _replay_tail(uni, log, replicas)
     return uni

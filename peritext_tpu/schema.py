@@ -20,7 +20,7 @@ go stale when a type registers after a kernel has been traced.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Mapping, Tuple
+from typing import Tuple
 
 
 @dataclass(frozen=True)
@@ -34,7 +34,7 @@ class MarkSpec:
 
 # The four mark types of the reference schema, in declaration order.
 # Reference: schema.ts:46-95 and ALL_MARKS at schema.ts:125.
-MARK_SPEC: dict = {
+MARK_SPEC: "dict[str, MarkSpec]" = {
     "strong": MarkSpec(inclusive=True, allow_multiple=False),
     "em": MarkSpec(inclusive=True, allow_multiple=False),
     "comment": MarkSpec(inclusive=False, allow_multiple=True, attr_keys=("id",)),
