@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Fleet demo: the north-star workload through the public batched API.
+
+Hundreds-to-thousands of document replicas resident on device as one
+TpuUniverse, ingesting concurrent edit streams in a single launch per round,
+convergence-checked with one batched digest computation (BASELINE.json
+configs 3-5 shape).  FLEET_REPLICAS / FLEET_ROUNDS env vars scale it up on
+real hardware.
+"""
+import os
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    replicas = int(os.environ.get("FLEET_REPLICAS", "256"))
+    rounds = int(os.environ.get("FLEET_ROUNDS", "3"))
+
+    from peritext_tpu.bench.workloads import make_merge_workload
+    from peritext_tpu.ops import TpuUniverse
+
+    # Four distinct writer streams over a shared 400-char genesis document.
+    workload = make_merge_workload(doc_len=400, ops_per_merge=48, num_streams=4, seed=7)
+    streams = workload["streams"]
+    names = [f"replica-{i:05d}" for i in range(replicas)]
+    uni = TpuUniverse(names, capacity=1024, max_mark_ops=256)
+
+    t0 = time.perf_counter()
+    uni.apply_changes({name: [workload["genesis"]] for name in names})
+    print(f"genesis: {replicas} replicas bootstrapped in {time.perf_counter()-t0:.2f}s")
+
+    total_ops = 0
+    for rnd in range(rounds):
+        # Each replica merges one writer stream per round, round-robin — so
+        # after every round, replicas on the same stream schedule must agree.
+        batch = {}
+        for i, name in enumerate(names):
+            stream = streams[(i + rnd) % len(streams)]
+            batch[name] = stream
+            total_ops += sum(len(c["ops"]) for c in stream)
+        t0 = time.perf_counter()
+        uni.apply_changes(batch)
+        dt = time.perf_counter() - t0
+        print(f"round {rnd}: merged {len(streams)} streams across {replicas} replicas in {dt:.2f}s")
+
+    # After `rounds` round-robin rounds every replica has seen streams
+    # {(i+r) % 4}, so replicas with i % 4 equal share identical histories.
+    digests = uni.digests()
+    groups = Counter()
+    for i, digest in enumerate(digests):
+        groups[(i % len(streams), int(digest))] += 1
+    schedules = {}
+    for (schedule, digest), count in groups.items():
+        schedules.setdefault(schedule, set()).add(digest)
+    for schedule, unique in sorted(schedules.items()):
+        status = "CONVERGED" if len(unique) == 1 else f"DIVERGED ({len(unique)} states)"
+        print(f"schedule class {schedule}: {status}")
+    assert all(len(u) == 1 for u in schedules.values()), "fleet diverged!"
+
+    spans = uni.spans(names[0])
+    text = "".join(s["text"] for s in spans)
+    marked = sum(1 for s in spans if s["marks"])
+    print(
+        f"\nfleet consistent: {replicas} replicas, {total_ops} ops merged; "
+        f"replica-0: {len(text)} chars in {len(spans)} spans ({marked} marked)"
+    )
+
+
+if __name__ == "__main__":
+    main()
