@@ -1,0 +1,126 @@
+"""Explicit sequence-parallel kernels with shard_map + manual collectives.
+
+GSPMD already shards the jnp kernels over the ``seq`` axis automatically
+(parallel/mesh.py); this module is the *explicit* formulation — the document
+dimension split across chips with hand-placed collectives over ICI — for the
+long-document regime where one replica's sequence spans a slice:
+
+- the mark-inheritance carry (``getTextWithFormatting``'s left-to-right
+  walk, peritext.ts:366-390) becomes: a local prefix resolution per shard, a
+  one-element **halo exchange** to the right neighbor (``ppermute`` ring
+  shift) for the after-slot of each shard's last element, and a shard-level
+  prefix over "last defined boundary per shard" summaries (``all_gather``
+  along the seq axis — S summaries of W words each, a few hundred bytes on
+  the wire, vs. the O(C) state that stays put).
+
+The result is bit-identical to the single-device ``flatten_sources``
+(tests/test_shard_map.py) while the per-shard work and memory scale as C/S.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+
+def _row_at(rows: jax.Array, idx: jax.Array) -> jax.Array:
+    """rows[idx] for a traced idx, safe for idx = -1 (returns zeros)."""
+    safe = jnp.maximum(idx, 0)
+    row = lax.dynamic_slice_in_dim(rows, safe, 1, axis=0)[0]
+    return jnp.where(idx >= 0, row, jnp.zeros_like(row))
+
+
+def _sharded_flatten_local(
+    elem_deleted, bnd_def, bnd_mask, length, *, seq_size: int
+):
+    """Per-shard body: local resolution + halo + shard-prefix carry.
+
+    Operates on one replica's local slice (c_local elements).  Uses the
+    ``seq`` axis name for collectives.
+    """
+    c_local = elem_deleted.shape[0]
+    shard = lax.axis_index("seq")
+    elem_offset = shard * c_local
+    ar_local = jnp.arange(c_local, dtype=jnp.int32)
+    ar_global = elem_offset + ar_local
+    live = ar_global < length
+
+    before_def = bnd_def[0::2] & live
+    after_def = bnd_def[1::2] & live
+    before_rows = bnd_mask[0::2]
+    after_rows = bnd_mask[1::2]
+
+    # Halo exchange: each shard sends its last element's (after_def, after
+    # row) to its right neighbor over the ICI ring; shard 0 receives zeros.
+    perm = [(s, s + 1) for s in range(seq_size - 1)]
+    halo_def = lax.ppermute(after_def[-1], "seq", perm)
+    halo_row = lax.ppermute(after_rows[-1], "seq", perm)
+
+    prev_after_def = jnp.concatenate([halo_def[None], after_def[:-1]])
+    prev_after_rows = jnp.concatenate([halo_row[None], after_rows[:-1]])
+
+    # Element-level decision (reference peritext.ts:372-376): own before
+    # slot wins, else the previous element's after slot.
+    d_has = before_def | prev_after_def
+    d_rows = jnp.where(before_def[:, None], before_rows, prev_after_rows)
+
+    # Local prefix: nearest deciding element at or left of each element.
+    src = lax.cummax(jnp.where(d_has, ar_local, jnp.int32(-1)))
+    local_rows = jax.vmap(lambda i: _row_at(d_rows, i))(src)
+    local_has = src >= 0
+
+    # Shard summary: this shard's last deciding row (if any), gathered
+    # across the seq axis so each shard can take the nearest preceding one.
+    last_idx = jnp.max(jnp.where(d_has, ar_local, jnp.int32(-1)))
+    summary_row = _row_at(d_rows, last_idx)
+    summary_has = last_idx >= 0
+    all_rows = lax.all_gather(summary_row, "seq")  # [S, W]
+    all_has = lax.all_gather(summary_has, "seq")  # [S]
+
+    s_idx = jnp.arange(seq_size, dtype=jnp.int32)
+    prev_shards = all_has & (s_idx < shard)
+    pick = jnp.max(jnp.where(prev_shards, s_idx, jnp.int32(-1)))
+    incoming_row = _row_at(all_rows, pick)
+    incoming_has = pick >= 0
+
+    mask = jnp.where(local_has[:, None], local_rows, incoming_row[None, :])
+    has = local_has | incoming_has
+    return mask, has
+
+
+def flatten_sources_sp(mesh: Mesh):
+    """shard_map-compiled sequence-parallel flatten over (replica, seq).
+
+    Takes the batched raw arrays (deleted [R, C], bnd_def [R, 2C],
+    bnd_mask [R, 2C, W], length [R]) and returns (mask [R, C, W],
+    has [R, C]) identical to jax.vmap(kernels.flatten_sources).
+    """
+    seq_size = mesh.shape["seq"]
+
+    def per_replica(deleted, bnd_def, bnd_mask, length):
+        return _sharded_flatten_local(
+            deleted, bnd_def, bnd_mask, length, seq_size=seq_size
+        )
+
+    def batched(deleted, bnd_def, bnd_mask, length):
+        return jax.vmap(per_replica)(deleted, bnd_def, bnd_mask, length)
+
+    mapped = shard_map(
+        batched,
+        mesh=mesh,
+        in_specs=(
+            P("replica", "seq"),
+            P("replica", "seq"),
+            P("replica", "seq", None),
+            P("replica"),
+        ),
+        out_specs=(P("replica", "seq", None), P("replica", "seq")),
+    )
+    return jax.jit(mapped)

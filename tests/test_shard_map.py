@@ -1,0 +1,33 @@
+"""Explicit shard_map sequence parallelism equals the single-device kernel."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.parallel import make_mesh
+from peritext_tpu.parallel.shard import flatten_sources_sp
+
+
+@pytest.mark.parametrize("seq", [2, 4, 8])
+def test_shard_map_flatten_matches_single_device(seq):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    workload = make_merge_workload(doc_len=100, ops_per_merge=32, num_streams=4, seed=5)
+    batch = build_device_batch(workload, num_replicas=8, capacity=256, max_mark_ops=64)
+    states = K.merge_step_batch(
+        batch["states"],
+        jnp.asarray(batch["text_ops"]),
+        jnp.asarray(batch["mark_ops"]),
+        jnp.asarray(batch["ranks"]),
+    )
+
+    ref_mask, ref_has = jax.vmap(K.flatten_sources)(states)
+
+    mesh = make_mesh(jax.devices()[: 8], 8 // seq, seq)
+    sp = flatten_sources_sp(mesh)
+    mask, has = sp(states.deleted, states.bnd_def, states.bnd_mask, states.length)
+
+    assert (np.asarray(mask) == np.asarray(ref_mask)).all()
+    assert (np.asarray(has) == np.asarray(ref_has)).all()
