@@ -30,6 +30,19 @@ def test_reference_trace_replays_convergently(path):
     assert isinstance(spans, list)
 
 
+@pytest.mark.parametrize("name", ["links-minimal.json", "links-brief.json"])
+def test_reference_trace_replays_on_device_engine(name):
+    """The device engine ingests the reference's raw change-log traces and
+    lands on exactly the oracle's state (a CI-sized subset; the full set
+    replays through the oracle above)."""
+    from peritext_tpu.ops import TpuDoc
+
+    queues = load_trace(os.path.join(TRACE_DIR, name))["queues"]
+    oracle_spans = assert_replay_converges(queues)
+    engine_spans = assert_replay_converges(queues, doc_factory=TpuDoc)
+    assert engine_spans == oracle_spans
+
+
 def test_event_trace_session_matches_concurrent_harness():
     trace = concurrent_spec_to_trace(
         "The Peritext editor",
