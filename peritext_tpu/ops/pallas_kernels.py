@@ -158,6 +158,241 @@ def text_phase_pallas(
     return ec, ea, dl.astype(bool), ch, oi, ln[:, 0]
 
 
+def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
+                 def_out, mask_out, mcount_out, *, num_ops: int, c: int, w: int):
+    """Mark phase in VMEM: one replica block per grid step.
+
+    Layout: boundary masks flattened word-major as [B, W * 2C] (word block w
+    occupies lanes [w*2C, (w+1)*2C)), so per-slot operations are lane
+    tilings and the per-op bit targets exactly one word block.  Boundary
+    definedness def as [B, 2C] int32.  The mark TABLE columns are not
+    carried here — the host appends them (they are tiny and independent of
+    slot state); only mark_count is tracked for bit allocation.
+
+    Per op (see kernels._apply_mark_fast for the write-class derivation):
+    - defined slots inside [s, e): OR in the op bit (own-row carry);
+    - slot s: nearest-defined-at-or-left carry row | bit;
+    - slot e (when not endOfText): plain carry row.
+    The two carry rows are masked max-reductions per word block — no
+    gathers.
+    """
+    b = def_in.shape[0]
+    def_out[:] = def_in[:]
+    mask_out[:] = mask_in[:]
+    mcount_out[:] = mc_in[:]
+
+    pos = lax.broadcasted_iota(jnp.int32, (b, c), 1)  # element index
+    slot2 = lax.broadcasted_iota(jnp.int32, (b, 2 * c), 1)  # slot index
+    lane = lax.broadcasted_iota(jnp.int32, (b, w * 2 * c), 1)
+    lane_slot = lane % (2 * c)
+    lane_word = lane // (2 * c)
+
+    def body(l, _):
+        def col(f):
+            return ops_ref[:, pl.ds(l * OPF + f, 1)]  # [B, 1]
+
+        kind = col(K.K_KIND)
+        is_mark = kind == K.KIND_MARK
+        ln = ln_in[:]
+        live_e = pos < ln
+
+        ecv, eav = ec_in[:], ea_in[:]
+        s_match = live_e & (ecv == col(K.K_SCTR)) & (eav == col(K.K_SACT))
+        s_elem = jnp.min(jnp.where(s_match, pos, c), axis=1, keepdims=True)
+        s_slot = 2 * s_elem + col(K.K_SKIND)
+        e_match = live_e & (ecv == col(K.K_ECTR)) & (eav == col(K.K_EACT))
+        e_elem = jnp.min(jnp.where(e_match, pos, c), axis=1, keepdims=True)
+        ekind = col(K.K_EKIND)
+        e_slot = jnp.where(
+            ekind == 2, 2 * c + 2, 2 * e_elem + jnp.minimum(ekind, 1)
+        )
+
+        dfv = def_out[:]
+        defined = (dfv != 0) & (slot2 < 2 * ln)
+        mkv = mask_out[:]
+
+        m = mcount_out[:]  # [B, 1]
+        bit = jnp.uint32(1) << (m % 32).astype(jnp.uint32)
+        word_of_m = m // 32
+
+        s_lt_e = s_slot < e_slot
+        in_range2 = (slot2 >= s_slot) & (slot2 < e_slot) & s_lt_e & is_mark
+
+        # Carry rows for s and e: masked max over lanes per word block.
+        def carry_row(target_slot):
+            src = jnp.max(
+                jnp.where(defined & (slot2 <= target_slot), slot2, -1),
+                axis=1,
+                keepdims=True,
+            )  # [B, 1]
+            sel = lane_slot == src  # [B, W*2C]; no lane selected when src=-1
+            vals = jnp.where(sel, mkv, jnp.uint32(0))
+            # Reduce each word block's 2C lanes to one value, then broadcast
+            # back to the block's lanes.
+            blocks = vals.reshape(b, w, 2 * c).max(axis=2)  # [B, W]
+            return blocks  # per word block carry value
+
+        row_s = carry_row(s_slot)  # [B, W]
+        bit_blocks = jnp.where(
+            jnp.arange(w, dtype=jnp.int32)[None, :] == word_of_m, bit, jnp.uint32(0)
+        )  # [B, W]
+        row_s = row_s | bit_blocks
+        e_clamped = jnp.minimum(e_slot, 2 * c - 1)
+        row_e = carry_row(e_clamped)
+
+        # 1) OR the bit into defined in-range lanes of word word_of_m.
+        or_mask = in_range2  # [B, 2C] slot-level
+        or_lanes = jnp.tile(or_mask & defined, (1, w)) & (lane_word == word_of_m)
+        new_mask = jnp.where(or_lanes, mkv | bit, mkv)
+
+        # 2) slot s write: row_s word values at lanes lane_slot == s_slot.
+        write_s = is_mark & s_lt_e
+        s_lanes = (lane_slot == s_slot) & write_s
+        row_s_lanes = jnp.repeat(row_s, 2 * c, axis=1)
+        new_mask = jnp.where(s_lanes, row_s_lanes, new_mask)
+
+        # 3) slot e write (skipped for endOfText).
+        write_e = is_mark & (e_slot < 2 * c)
+        e_lanes = (lane_slot == e_slot) & write_e
+        row_e_lanes = jnp.repeat(row_e, 2 * c, axis=1)
+        new_mask = jnp.where(e_lanes, row_e_lanes, new_mask)
+
+        mask_out[:] = new_mask
+        new_def = (
+            dfv
+            | (or_mask & defined).astype(jnp.int32)
+            | ((slot2 == s_slot) & write_s).astype(jnp.int32)
+            | ((slot2 == e_slot) & write_e).astype(jnp.int32)
+        )
+        def_out[:] = new_def
+        mcount_out[:] = m + is_mark.astype(jnp.int32)
+        return 0
+
+    lax.fori_loop(0, num_ops, body, 0)
+
+
+def _update_mark_table(states, mark_ops):
+    """Append each replica's mark rows to its mark table (device scatter).
+
+    Table entries are independent of boundary state, so they update in one
+    vectorized pass: entry position = mark_count + rank of the mark row
+    within its batch.
+    """
+    is_mark = mark_ops[:, :, K.K_KIND] == K.KIND_MARK  # [R, L]
+    order = jnp.cumsum(is_mark.astype(jnp.int32), axis=1) - 1
+    idx = states.mark_count[:, None] + order  # [R, L]
+    m_cap = states.max_mark_ops
+    safe_idx = jnp.where(is_mark, idx, m_cap)  # OOB writes drop
+
+    def scatter(col, field):
+        return jax.vmap(lambda arr, i, v: arr.at[i].set(v))(
+            col, safe_idx, mark_ops[:, :, field]
+        )
+
+    return dataclasses.replace(
+        states,
+        mark_ctr=scatter(states.mark_ctr, K.K_CTR),
+        mark_act=scatter(states.mark_act, K.K_ACT),
+        mark_action=scatter(states.mark_action, K.K_MACTION),
+        mark_type=scatter(states.mark_type, K.K_MTYPE),
+        mark_attr=scatter(states.mark_attr, K.K_MATTR),
+        mark_count=states.mark_count + is_mark.sum(axis=1).astype(jnp.int32),
+    )
+
+
+def mark_phase_pallas(
+    bnd_def, bnd_mask, elem_ctr, elem_act, length, mark_count, mark_ops,
+    interpret: bool = False,
+):
+    """Run the boundary-set mark phase in VMEM (see _mark_kernel).
+
+    Inputs are the batched arrays ([R, 2C] def, [R, 2C, W] masks, element
+    id arrays, lengths, mark counts) plus mark-op rows [R, L, OP_FIELDS].
+    Returns (bnd_def, bnd_mask) updated.
+    """
+    r, two_c, w_words = bnd_mask.shape
+    c = two_c // 2
+    num_ops = mark_ops.shape[1]
+    if r % REPLICA_BLOCK != 0:
+        raise ValueError(f"replica count {r} must be a multiple of {REPLICA_BLOCK}")
+
+    # Word-major flatten: word block w occupies lanes [w*2C, (w+1)*2C).
+    mask_flat = jnp.transpose(bnd_mask, (0, 2, 1)).reshape(r, w_words * two_c)
+    ops_ext = jnp.concatenate(
+        [mark_ops, jnp.zeros((r, num_ops, OPF - K.OP_FIELDS), jnp.int32)], axis=2
+    ).reshape(r, num_ops * OPF)
+
+    b = REPLICA_BLOCK
+
+    def spec(width):
+        return pl.BlockSpec((b, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    outs = pl.pallas_call(
+        functools.partial(_mark_kernel, num_ops=num_ops, c=c, w=w_words),
+        grid=(r // b,),
+        in_specs=[
+            spec(num_ops * OPF),
+            spec(two_c),
+            spec(w_words * two_c),
+            spec(c),
+            spec(c),
+            spec(1),
+            spec(1),
+        ],
+        out_specs=[spec(two_c), spec(w_words * two_c), spec(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, two_c), jnp.int32),
+            jax.ShapeDtypeStruct((r, w_words * two_c), jnp.uint32),
+            jax.ShapeDtypeStruct((r, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        ops_ext,
+        bnd_def.astype(jnp.int32),
+        mask_flat,
+        elem_ctr,
+        elem_act,
+        length[:, None],
+        mark_count[:, None],
+    )
+    new_def, new_mask_flat, _ = outs
+    new_mask = jnp.transpose(new_mask_flat.reshape(r, w_words, two_c), (0, 2, 1))
+    return new_def.astype(bool), new_mask
+
+
+def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool = False):
+    """Fully VMEM-resident merge: Pallas text phase + permute + Pallas mark
+    phase + device table append.  State-equivalent to merge_step."""
+    ec, ea, dl, ch, oi, ln = text_phase_pallas(
+        states.elem_ctr,
+        states.elem_act,
+        states.deleted,
+        states.chars,
+        states.length,
+        text_ops,
+        ranks,
+        interpret=interpret,
+    )
+    bnd_def, bnd_mask = jax.vmap(K._permute_boundaries)(
+        states.bnd_def, states.bnd_mask, oi
+    )
+    new_def, new_mask = mark_phase_pallas(
+        bnd_def, bnd_mask, ec, ea, ln, states.mark_count, mark_ops,
+        interpret=interpret,
+    )
+    out = dataclasses.replace(
+        states,
+        elem_ctr=ec,
+        elem_act=ea,
+        deleted=dl,
+        chars=ch,
+        length=ln,
+        bnd_def=new_def,
+        bnd_mask=new_mask,
+    )
+    return _update_mark_table(out, mark_ops)
+
+
 def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool = False):
     """Fast merge with the Pallas text phase: VMEM-resident text application,
     then the standard boundary permute + mark phase (kernels.merge_step's
