@@ -30,6 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.state import MASK_WORD_BITS
 
 # Extended op row: kernels.OP_FIELDS fields + the op actor's rank, padded so
 # a row is 16 lanes.
@@ -169,6 +170,11 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
     carried here — the host appends them (they are tiny and independent of
     slot state); only mark_count is tracked for bit allocation.
 
+    NOTE: validated in interpret mode; the broadcast+reshape lane
+    expansions and the per-word-block reshape reduction have not yet been
+    compiled under Mosaic on hardware (the tunnel was down this round) —
+    re-verify lowering before enabling this path in the benchmark.
+
     Per op (see kernels._apply_mark_fast for the write-class derivation):
     - defined slots inside [s, e): OR in the op bit (own-row carry);
     - slot s: nearest-defined-at-or-left carry row | bit;
@@ -197,12 +203,16 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         live_e = pos < ln
 
         ecv, eav = ec_in[:], ea_in[:]
-        s_match = live_e & (ecv == col(K.K_SCTR)) & (eav == col(K.K_SACT))
-        s_elem = jnp.min(jnp.where(s_match, pos, c), axis=1, keepdims=True)
-        s_slot = 2 * s_elem + col(K.K_SKIND)
-        e_match = live_e & (ecv == col(K.K_ECTR)) & (eav == col(K.K_EACT))
-        e_elem = jnp.min(jnp.where(e_match, pos, c), axis=1, keepdims=True)
+        # First-match index with the XLA path's argmax(all-False) == 0
+        # fallback, so unresolved anchors behave identically on both paths.
+        def first_match(mctr, mact):
+            match = live_e & (ecv == mctr) & (eav == mact)
+            first = jnp.min(jnp.where(match, pos, c), axis=1, keepdims=True)
+            return jnp.where(first == c, 0, first)
+
+        s_slot = 2 * first_match(col(K.K_SCTR), col(K.K_SACT)) + col(K.K_SKIND)
         ekind = col(K.K_EKIND)
+        e_elem = first_match(col(K.K_ECTR), col(K.K_EACT))
         e_slot = jnp.where(
             ekind == 2, 2 * c + 2, 2 * e_elem + jnp.minimum(ekind, 1)
         )
@@ -212,8 +222,8 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         mkv = mask_out[:]
 
         m = mcount_out[:]  # [B, 1]
-        bit = jnp.uint32(1) << (m % 32).astype(jnp.uint32)
-        word_of_m = m // 32
+        bit = jnp.uint32(1) << (m % MASK_WORD_BITS).astype(jnp.uint32)
+        word_of_m = m // MASK_WORD_BITS
 
         s_lt_e = s_slot < e_slot
         in_range2 = (slot2 >= s_slot) & (slot2 < e_slot) & s_lt_e & is_mark
@@ -227,10 +237,9 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
             )  # [B, 1]
             sel = lane_slot == src  # [B, W*2C]; no lane selected when src=-1
             vals = jnp.where(sel, mkv, jnp.uint32(0))
-            # Reduce each word block's 2C lanes to one value, then broadcast
-            # back to the block's lanes.
-            blocks = vals.reshape(b, w, 2 * c).max(axis=2)  # [B, W]
-            return blocks  # per word block carry value
+            # Reduce each word block's 2C lanes to one value (at most one
+            # lane per block is selected).
+            return vals.reshape(b, w, 2 * c).max(axis=2)  # [B, W]
 
         row_s = carry_row(s_slot)  # [B, W]
         bit_blocks = jnp.where(
@@ -242,19 +251,20 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
 
         # 1) OR the bit into defined in-range lanes of word word_of_m.
         or_mask = in_range2  # [B, 2C] slot-level
-        or_lanes = jnp.tile(or_mask & defined, (1, w)) & (lane_word == word_of_m)
+        or_slots = or_mask & defined
+        or_lanes = jnp.concatenate([or_slots] * w, axis=1) & (lane_word == word_of_m)
         new_mask = jnp.where(or_lanes, mkv | bit, mkv)
 
         # 2) slot s write: row_s word values at lanes lane_slot == s_slot.
         write_s = is_mark & s_lt_e
         s_lanes = (lane_slot == s_slot) & write_s
-        row_s_lanes = jnp.repeat(row_s, 2 * c, axis=1)
+        row_s_lanes = jnp.broadcast_to(row_s[:, :, None], (b, w, 2 * c)).reshape(b, w * 2 * c)
         new_mask = jnp.where(s_lanes, row_s_lanes, new_mask)
 
         # 3) slot e write (skipped for endOfText).
         write_e = is_mark & (e_slot < 2 * c)
         e_lanes = (lane_slot == e_slot) & write_e
-        row_e_lanes = jnp.repeat(row_e, 2 * c, axis=1)
+        row_e_lanes = jnp.broadcast_to(row_e[:, :, None], (b, w, 2 * c)).reshape(b, w * 2 * c)
         new_mask = jnp.where(e_lanes, row_e_lanes, new_mask)
 
         mask_out[:] = new_mask

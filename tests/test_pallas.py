@@ -8,37 +8,14 @@ import pytest
 
 from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
 from peritext_tpu.ops import kernels as K
-from peritext_tpu.ops.pallas_kernels import merge_step_pallas
+from peritext_tpu.ops.pallas_kernels import merge_step_pallas, merge_step_pallas_full
 
 
+@pytest.mark.parametrize("merge_fn", [merge_step_pallas, merge_step_pallas_full])
 @pytest.mark.parametrize("seed", [0, 1])
-def test_pallas_merge_matches_xla(seed):
-    workload = make_merge_workload(
-        doc_len=100, ops_per_merge=24, num_streams=4, with_marks=True, seed=seed
-    )
-    batch = build_device_batch(workload, num_replicas=8, capacity=256, max_mark_ops=64)
-    text_ops = jnp.asarray(batch["text_ops"])
-    mark_ops = jnp.asarray(batch["mark_ops"])
-    ranks = jnp.asarray(batch["ranks"])
-    states = batch["states"]
-
-    ref = K.merge_step_batch(states, text_ops, mark_ops, ranks)
-    out = merge_step_pallas(states, text_ops, mark_ops, ranks, interpret=True)
-
-    import dataclasses
-
-    for field in dataclasses.fields(ref):
-        a = np.asarray(getattr(ref, field.name))
-        b = np.asarray(getattr(out, field.name))
-        assert (a == b).all(), f"field {field.name} diverged"
-
-
-@pytest.mark.parametrize("seed", [0, 1])
-def test_pallas_full_merge_matches_xla(seed):
-    """Fully VMEM-resident merge (text + mark phases in Pallas) must equal
+def test_pallas_merge_matches_xla(merge_fn, seed):
+    """Pallas merges (text-phase-only, and fully VMEM-resident) must equal
     the XLA path on every state field."""
-    from peritext_tpu.ops.pallas_kernels import merge_step_pallas_full
-
     workload = make_merge_workload(
         doc_len=100, ops_per_merge=24, num_streams=4, with_marks=True, seed=seed
     )
@@ -49,7 +26,7 @@ def test_pallas_full_merge_matches_xla(seed):
     states = batch["states"]
 
     ref = K.merge_step_batch(states, text_ops, mark_ops, ranks)
-    out = merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret=True)
+    out = merge_fn(states, text_ops, mark_ops, ranks, interpret=True)
 
     import dataclasses
 
