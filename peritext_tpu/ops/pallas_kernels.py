@@ -39,6 +39,14 @@ OPF = 16
 REPLICA_BLOCK = 8
 
 
+def _resolve_interpret(interpret):
+    """None -> interpret everywhere but real TPU backends (pallas_call
+    compiles only there; CPU runs the interpreter)."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
 def _text_kernel(ops_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
                  ec, ea, er, dl, ch, oi, ln, *, num_ops: int):
     b, c = ec_in.shape
@@ -109,10 +117,11 @@ def text_phase_pallas(
     length: jax.Array,  # [R] int32
     text_ops: jax.Array,  # [R, L, OP_FIELDS] int32
     ranks: jax.Array,  # [A] int32
-    interpret: bool = False,
+    interpret: bool | None = None,
 ):
     """Run the text phase in VMEM.  Returns the updated element arrays plus
     the orig-index permutation plane for boundary-table realignment."""
+    interpret = _resolve_interpret(interpret)
     r, c = elem_ctr.shape
     num_ops = text_ops.shape[1]
     if r % REPLICA_BLOCK != 0:
@@ -312,7 +321,7 @@ def _update_mark_table(states, mark_ops):
 
 def mark_phase_pallas(
     bnd_def, bnd_mask, elem_ctr, elem_act, length, mark_count, mark_ops,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ):
     """Run the boundary-set mark phase in VMEM (see _mark_kernel).
 
@@ -320,6 +329,7 @@ def mark_phase_pallas(
     id arrays, lengths, mark counts) plus mark-op rows [R, L, OP_FIELDS].
     Returns (bnd_def, bnd_mask) updated.
     """
+    interpret = _resolve_interpret(interpret)
     r, two_c, w_words = bnd_mask.shape
     c = two_c // 2
     num_ops = mark_ops.shape[1]
@@ -370,7 +380,7 @@ def mark_phase_pallas(
     return new_def.astype(bool), new_mask
 
 
-def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool = False):
+def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool | None = None):
     """Fully VMEM-resident merge: Pallas text phase + permute + Pallas mark
     phase + device table append.  State-equivalent to merge_step."""
     ec, ea, dl, ch, oi, ln = text_phase_pallas(
@@ -403,7 +413,7 @@ def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool = 
     return _update_mark_table(out, mark_ops)
 
 
-def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool = False):
+def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool | None = None):
     """Fast merge with the Pallas text phase: VMEM-resident text application,
     then the standard boundary permute + mark phase (kernels.merge_step's
     tail), batched over replicas."""
@@ -453,5 +463,5 @@ def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool = False
     return jax.vmap(tail, in_axes=(0, 0, 0))(new_states, oi, mark_ops)
 
 
-def merge_step_pallas_jit(interpret: bool = False):
+def merge_step_pallas_jit(interpret: bool | None = None):
     return jax.jit(functools.partial(merge_step_pallas, interpret=interpret))
