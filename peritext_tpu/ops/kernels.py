@@ -173,6 +173,13 @@ def _mark_slot_context(state: DocState, op):
         2 * jnp.argmax(e_match).astype(jnp.int32) + jnp.minimum(op[K_EKIND], 1),
     )
 
+    # Walk-order subtlety: when start and end anchor the same slot the
+    # walk's start branch fires first and the end match can never fire
+    # afterwards (calculateOpsForPosition checks start before end,
+    # peritext.ts:236-241), so the op extends to the end of the document —
+    # exactly the endOfText behavior.
+    e_slot = jnp.where(e_slot == s_slot, big, e_slot)
+
     slots = jnp.arange(2 * c, dtype=jnp.int32)
     defined = state.bnd_def & (slots < 2 * state.length)
     src = lax.cummax(jnp.where(defined, slots, jnp.int32(-1)))
@@ -520,6 +527,9 @@ def _apply_mark_fast(carry, op, elem_ctr, elem_act, length):
         big,
         2 * jnp.argmax(e_match).astype(jnp.int32) + jnp.minimum(op[K_EKIND], 1),
     )
+    # Same-slot anchors: start branch wins in the walk -> endOfText behavior
+    # (see _mark_slot_context).
+    e_slot = jnp.where(e_slot == s_slot, big, e_slot)
 
     slots = jnp.arange(2 * c, dtype=jnp.int32)
     defined = bnd_def & (slots < 2 * length)

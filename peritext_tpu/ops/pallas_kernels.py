@@ -225,6 +225,8 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         e_slot = jnp.where(
             ekind == 2, 2 * c + 2, 2 * e_elem + jnp.minimum(ekind, 1)
         )
+        # Same-slot anchors: start branch wins -> endOfText behavior.
+        e_slot = jnp.where(e_slot == s_slot, 2 * c + 2, e_slot)
 
         dfv = def_out[:]
         defined = (dfv != 0) & (slot2 < 2 * ln)
