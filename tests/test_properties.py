@@ -39,7 +39,15 @@ def materialize(doc, spec):
             return None
         return {"path": ["text"], "action": "delete", "index": index, "count": count}
     start = int(f1 * (length - 1))
-    end = start + max(1, int(f2 * (length - start)))
+    end = start + int(f2 * (length - start + 0.999))
+    from peritext_tpu.schema import MARK_SPEC
+
+    if end <= start:
+        # Zero-width marks are legal quirks (see test_zero_width_marks) —
+        # except non-inclusive at the origin, which raises in both engines.
+        end = start
+        if not MARK_SPEC[mark_type].inclusive and start == 0:
+            return None
     op = {
         "path": ["text"],
         "action": kind,
