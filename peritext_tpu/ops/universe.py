@@ -181,6 +181,17 @@ class TpuUniverse:
         self.lengths = [0] * len(self.replica_ids)
         self.mark_counts = [0] * len(self.replica_ids)
         self.roots: List[Dict[str, Any]] = [dict() for _ in self.replica_ids]
+        # Lightweight observability counters (the reference's observability
+        # is console logging + the demo op panel, SURVEY §5; at batch scale
+        # these are what perf debugging needs).
+        self.stats: Dict[str, int] = {
+            "launches": 0,
+            "ops_applied": 0,
+            "rows_padded": 0,
+            "capacity_growths": 0,
+            "changes_ingested": 0,
+            "duplicates_dropped": 0,
+        }
 
     # -- capacity management ------------------------------------------------
 
@@ -191,6 +202,7 @@ class TpuUniverse:
         while need_marks > new_m:
             new_m *= 2
         if (new_c, new_m) != (self.capacity, self.max_mark_ops):
+            self.stats["capacity_growths"] += 1
             states = [
                 grow_state(index_state(self.states, i), new_c, new_m)
                 for i in range(len(self.replica_ids))
@@ -228,6 +240,9 @@ class TpuUniverse:
             if c["seq"] > clock.get(c["actor"], 0) and key not in seen:
                 seen.add(key)
                 fresh.append(c)
+            else:
+                self.stats["duplicates_dropped"] += 1
+        self.stats["changes_ingested"] += len(fresh)
         ordered = causal_order(fresh, clock)
         for change in ordered:
             clock[change["actor"]] = change["seq"]
@@ -283,6 +298,15 @@ class TpuUniverse:
         mark_ops = np.stack([pad_rows(rows, mark_pad) for rows in mark_batches])
         bufs = np.stack([pad_buffer(buf, buf_pad) for buf in char_bufs])
         ranks = self._ranks()
+        self.stats["launches"] += 1
+        self.stats["ops_applied"] += int(
+            (text_ops[:, :, K.K_KIND] != K.KIND_PAD).sum()
+            + (mark_ops[:, :, K.K_KIND] != K.KIND_PAD).sum()
+        )
+        self.stats["rows_padded"] += int(
+            (text_ops[:, :, K.K_KIND] == K.KIND_PAD).sum()
+            + (mark_ops[:, :, K.K_KIND] == K.KIND_PAD).sum()
+        )
         self.states = K.merge_step_fused_batch(
             self.states,
             jax.numpy.asarray(text_ops),
@@ -341,6 +365,9 @@ class TpuUniverse:
         pad = bucket_length(max_rows)
         ops = np.stack([pad_rows(rows, pad) for rows in encoded])
         ranks = self._ranks()
+        self.stats["launches"] += 1
+        self.stats["ops_applied"] += int((ops[:, :, K.K_KIND] != K.KIND_PAD).sum())
+        self.stats["rows_padded"] += int((ops[:, :, K.K_KIND] == K.KIND_PAD).sum())
         self.states, records = K.apply_ops_patched_batch(
             self.states,
             jax.numpy.asarray(ops),
