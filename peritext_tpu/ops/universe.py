@@ -279,6 +279,7 @@ class TpuUniverse:
             self.lengths[r] += counts["insert"]
             self.mark_counts[r] += counts["mark"]
             any_rows = any_rows or rows.shape[0] > 0
+            self.stats["ops_applied"] += int(rows.shape[0])
             text_rows, mark_rows = split_rows(rows)
             text_rows, char_buf = fuse_insert_runs(text_rows)
             text_batches.append(text_rows)
@@ -299,10 +300,6 @@ class TpuUniverse:
         bufs = np.stack([pad_buffer(buf, buf_pad) for buf in char_bufs])
         ranks = self._ranks()
         self.stats["launches"] += 1
-        self.stats["ops_applied"] += int(
-            (text_ops[:, :, K.K_KIND] != K.KIND_PAD).sum()
-            + (mark_ops[:, :, K.K_KIND] != K.KIND_PAD).sum()
-        )
         self.stats["rows_padded"] += int(
             (text_ops[:, :, K.K_KIND] == K.KIND_PAD).sum()
             + (mark_ops[:, :, K.K_KIND] == K.KIND_PAD).sum()
@@ -344,6 +341,7 @@ class TpuUniverse:
         for r, changes in enumerate(batches):
             ordered = self._gate(r, changes)
             rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
+            self.stats["ops_applied"] += int(rows.shape[0])
             self._apply_host_ops(r, host_ops)
             mk = [
                 {**op, "path": ["text"]}
@@ -366,7 +364,6 @@ class TpuUniverse:
         ops = np.stack([pad_rows(rows, pad) for rows in encoded])
         ranks = self._ranks()
         self.stats["launches"] += 1
-        self.stats["ops_applied"] += int((ops[:, :, K.K_KIND] != K.KIND_PAD).sum())
         self.stats["rows_padded"] += int((ops[:, :, K.K_KIND] == K.KIND_PAD).sum())
         self.states, records = K.apply_ops_patched_batch(
             self.states,
