@@ -179,6 +179,119 @@ SCENARIOS = {
             {"action": "insert", "index": 5, "values": ["G"]},
         ],
     ),
+    "mark_handoff_insertion": dict(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "addMark", "startIndex": 12, "endIndex": 19, "markType": "em"},
+        ],
+        input_ops2=[{"action": "insert", "index": 12, "values": list("[1]")}],
+    ),
+    "insert_at_bold_unbold_boundary": dict(
+        initial_text="AC",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 1, "endIndex": 2, "markType": "strong"},
+        ],
+        input_ops2=[{"action": "insert", "index": 1, "values": ["B"]}],
+    ),
+    "insert_at_unbold_bold_boundary": dict(
+        initial_text="AC",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 0, "endIndex": 1, "markType": "strong"},
+        ],
+        input_ops2=[{"action": "insert", "index": 1, "values": ["B"]}],
+    ),
+    "concurrent_adjacent_marks": dict(
+        initial_text="ABCDE",
+        input_ops1=[{"action": "addMark", "startIndex": 1, "endIndex": 2, "markType": "strong"}],
+        input_ops2=[{"action": "addMark", "startIndex": 2, "endIndex": 3, "markType": "strong"}],
+    ),
+    "addmark_boundary_tombstones": dict(
+        initial_text="The *Peritext* editor",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 14, "markType": "strong"},
+            {"action": "delete", "index": 4, "count": 1},
+            {"action": "delete", "index": 12, "count": 1},
+        ],
+        input_ops2=[
+            {"action": "insert", "index": 5, "values": ["_"]},
+            {"action": "insert", "index": 14, "values": ["_"]},
+        ],
+    ),
+    "formatting_on_deleted_span": dict(
+        input_ops1=[{"action": "delete", "index": 4, "count": 9}],
+        input_ops2=[{"action": "addMark", "startIndex": 5, "endIndex": 11, "markType": "strong"}],
+    ),
+    "single_deleted_char_link": dict(
+        initial_text="ABCDE",
+        input_ops1=[{"action": "delete", "index": 2, "count": 1}],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 2,
+                "endIndex": 3,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            }
+        ],
+    ),
+    "mark_past_visible_end": dict(
+        initial_text="ABCDE",
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 2,
+                "endIndex": 4,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            },
+            {"action": "delete", "index": 1, "count": 2},
+            {"action": "delete", "index": 2, "count": 1},
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 3,
+                "endIndex": 5,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            }
+        ],
+    ),
+    "links_same_endpoint": dict(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 11,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+    ),
+    "bold_and_link_grow_differently": dict(
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+    ),
 }
 
 
