@@ -458,6 +458,19 @@ class TpuUniverse:
         deleted = np.asarray(state.deleted[:n])
         return "".join(chr(int(c)) for c, d in zip(chars, deleted) if not d)
 
+    def texts(self) -> List[str]:
+        """All replicas' visible texts from one batched device readback."""
+        chars = np.asarray(self.states.chars)
+        deleted = np.asarray(self.states.deleted)
+        lengths = np.asarray(self.states.length)
+        out = []
+        for r in range(len(self.replica_ids)):
+            n = int(lengths[r])
+            row = chars[r, :n]
+            keep = ~deleted[r, :n]
+            out.append("".join(chr(int(c)) for c in row[keep]))
+        return out
+
     def digests(self) -> np.ndarray:
         """Per-replica convergence digests in one batched device call."""
         ranks = jax.numpy.asarray(self._ranks())
