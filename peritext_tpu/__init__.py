@@ -24,6 +24,12 @@ def __getattr__(name):
         from peritext_tpu import schema
 
         return schema.ALL_MARKS
+    # Engine classes load lazily so oracle-only users never pay the jax
+    # import.
+    if name in ("TpuDoc", "TpuUniverse"):
+        from peritext_tpu import ops
+
+        return getattr(ops, name)
     raise AttributeError(name)
 
 __version__ = "0.1.0"

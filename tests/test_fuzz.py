@@ -17,3 +17,24 @@ def test_fuzz_with_comment_removal_converges():
 
 def test_fuzz_larger_doc():
     fuzz(iterations=100, seed=5, initial_text="The quick brown fox", max_insert_chars=4)
+
+
+def test_fuzz_failure_states_replay(tmp_path):
+    """The failure-observability loop: a FuzzError's saved state is a
+    replayable change-log trace (the reference's traces/*.json contract)."""
+    import json
+
+    from peritext_tpu.fuzz import FuzzError
+    from peritext_tpu.replay import assert_replay_converges
+
+    # Build a state the way fuzz's fail() does, from a healthy run's log.
+    result = fuzz(iterations=30, seed=2)
+    log = result["log"]
+    err = FuzzError(
+        "synthetic", {"queues": {a: log.changes_for(a) for a in log.actors}, "syncs": []}
+    )
+    path = tmp_path / "fail-trace.json"
+    err.save(str(path))
+    loaded = json.loads(path.read_text())
+    spans = assert_replay_converges(loaded["queues"])
+    assert spans == result["final_spans"]
