@@ -51,7 +51,15 @@ def attempt(platform: str | None, timeout: float) -> str | None:
 
 
 def main() -> None:
-    line = attempt(None, timeout=float(os.environ.get("BENCH_TIMEOUT", "900")))
+    # The default-platform attempt hits the TPU tunnel, which can wedge and
+    # hang at device init; give it its own (overridable) budget so a wedged
+    # tunnel can't eat the CPU fallback's time.
+    line = attempt(
+        None,
+        timeout=float(
+            os.environ.get("BENCH_TPU_TIMEOUT", os.environ.get("BENCH_TIMEOUT", "900"))
+        ),
+    )
     if line is None:
         # TPU tunnel unreachable or run failed: measure on CPU instead.
         line = attempt("cpu", timeout=float(os.environ.get("BENCH_TIMEOUT", "900")))

@@ -15,9 +15,25 @@ from collections import Counter
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _pin_platform() -> None:
+    """Pin the JAX platform before first backend use.
+
+    This image's sitecustomize registers an experimental TPU relay backend
+    and pins jax_platforms at interpreter start; when the relay is wedged the
+    first array creation hangs forever.  Default to the honest choice
+    (FLEET_PLATFORM or cpu) the way tests/conftest.py does; set
+    FLEET_PLATFORM=axon (or tpu) to run the fleet on real hardware.
+    """
+    import jax
+
+    platform = os.environ.get("FLEET_PLATFORM", "cpu")
+    jax.config.update("jax_platforms", platform)
+
+
 def main() -> None:
     replicas = int(os.environ.get("FLEET_REPLICAS", "256"))
     rounds = int(os.environ.get("FLEET_ROUNDS", "3"))
+    _pin_platform()
 
     from peritext_tpu.bench.workloads import make_merge_workload
     from peritext_tpu.ops import TpuUniverse

@@ -185,12 +185,20 @@ def encode_changes(
     changes: Sequence[Dict[str, Any]],
     actors: ActorRegistry,
     attrs: AttrRegistry,
+    text_obj: Optional[str] = None,
 ) -> Tuple[np.ndarray, List[Dict[str, Any]], Dict[str, int]]:
     """Flatten a causally-ordered change batch into device op rows.
 
     Returns (rows [N, OP_FIELDS], host_ops, counts) where host_ops are the
     structural ops skipped for host handling and counts tallies inserts and
     mark ops for capacity pre-checks.
+
+    ``text_obj`` is the replica's established root text-list id (None before
+    genesis).  Every device-bound op must target that list — the engine's
+    data plane is the single text list, and an op addressing any other
+    object (a second makeList, a nested list) raises loudly here instead of
+    being silently spliced into the text document (the reference dispatches
+    per-object, micromerge.ts:534-608; this engine deliberately does not).
     """
     rows: List[np.ndarray] = []
     host_ops: List[Dict[str, Any]] = []
@@ -199,8 +207,17 @@ def encode_changes(
         for op in change["ops"]:
             row = encode_internal_op(op, actors, attrs)
             if row is None:
+                if op["action"] == "makeList" and op.get("key") == "text" and text_obj is None:
+                    text_obj = op["opId"]
                 host_ops.append(op)
                 continue
+            obj = op.get("obj")
+            if obj != text_obj:
+                raise ValueError(
+                    f"op {op.get('opId')!r} targets object {obj!r}, but this "
+                    f"engine's device data plane is the root text list "
+                    f"({text_obj!r}); non-text list objects are host-side only"
+                )
             if row[K.K_KIND] == K.KIND_INSERT:
                 counts["insert"] += 1
             elif row[K.K_KIND] == K.KIND_MARK:

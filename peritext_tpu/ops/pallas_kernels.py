@@ -47,8 +47,23 @@ def _resolve_interpret(interpret):
     return interpret
 
 
-def _text_kernel(ops_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
-                 ec, ea, er, dl, ch, oi, ln, *, num_ops: int):
+def _var_roll(x, amt, nbits: int):
+    """Right-roll each sublane row of ``x`` by its own amount ``amt`` [B, 1].
+
+    Per-row dynamic shifts don't exist on the VPU; compose them from
+    ``nbits`` static power-of-two rolls selected per row by the bits of
+    ``amt`` (a barrel shifter over the lane axis).
+    """
+    out = x
+    for bit in range(nbits):
+        rolled = pltpu.roll(out, 1 << bit, 1)
+        sel = ((amt >> bit) & 1) != 0  # [B, 1] broadcasts over lanes
+        out = jnp.where(sel, rolled, out)
+    return out
+
+
+def _text_kernel(ops_ref, cb_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
+                 ec, ea, er, dl, ch, oi, ln, *, num_ops: int, w2: int):
     b, c = ec_in.shape
     ec[:] = ec_in[:]
     ea[:] = ea_in[:]
@@ -58,6 +73,8 @@ def _text_kernel(ops_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
     oi[:] = oi_in[:]
     ln[:] = ln_in[:]
     pos = lax.broadcasted_iota(jnp.int32, (b, c), 1)
+    k_bits = K.MAX_RUN_LEN.bit_length()  # run length <= MAX_RUN_LEN
+    w2_bits = w2.bit_length() - 1  # w2 is a power of two
 
     def body(l, _):
         def col(f):
@@ -77,14 +94,19 @@ def _text_kernel(ops_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
 
         live = pos < lnv
         is_ins = kind == K.KIND_INSERT
+        is_run = kind == K.KIND_INSERT_RUN
         is_del = kind == K.KIND_DELETE
+        any_ins = is_ins | is_run
+        k = jnp.where(is_run, col(K.K_RUN_LEN), 1)  # [B, 1] block width
 
         match = live & (ecv == ref_ctr) & (eav == ref_act)
         dlv = jnp.where(match & is_del, 1, dlv)
 
         # RGA position rule (kernels._rga_insert_position, vectorized over
         # the replica sublane): after the reference element, past the
-        # contiguous run of greater-id elements.
+        # contiguous run of greater-id elements.  A fused run takes the
+        # position of its first op (see kernels._apply_text_op's contiguity
+        # argument for why the whole chain lands contiguously there).
         is_head = (ref_ctr == 0) & (ref_act == 0)
         first = jnp.min(jnp.where(match, pos, c), axis=1, keepdims=True)
         idx = jnp.where(is_head, -1, first)
@@ -92,18 +114,27 @@ def _text_kernel(ops_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
         stop = (pos > idx) & ~(live & gt)
         t = jnp.min(jnp.where(stop, pos, c), axis=1, keepdims=True)
         keep = pos < t
-        here = pos == t
+        block = ~keep & (pos < t + k)
+        offset = pos - t
+
+        # Run characters: lane p of the block needs cb[payload + p - t].
+        # Roll the char plane right by (t - payload) per row so that value
+        # lands exactly on lane p — a gather-free per-row alignment.
+        cbv = cb_ref[:]
+        amt = jnp.remainder(t - payload, w2)
+        rolled_cb = _var_roll(cbv, amt, w2_bits)[:, :c]
+        char_vals = jnp.where(is_run, rolled_cb, payload)
 
         def splice(x, v):
-            return jnp.where(keep, x, jnp.where(here, v, pltpu.roll(x, 1, 1)))
+            return jnp.where(keep, x, jnp.where(block, v, _var_roll(x, k, k_bits)))
 
-        ec[:] = jnp.where(is_ins, splice(ecv, ctr), ecv)
-        ea[:] = jnp.where(is_ins, splice(eav, act), eav)
-        er[:] = jnp.where(is_ins, splice(erv, op_rank), erv)
-        dl[:] = jnp.where(is_ins, splice(dlv, 0), dlv)
-        ch[:] = jnp.where(is_ins, splice(chv, payload), chv)
-        oi[:] = jnp.where(is_ins, splice(oiv, -1), oiv)
-        ln[:] = lnv + is_ins.astype(jnp.int32)
+        ec[:] = jnp.where(any_ins, splice(ecv, ctr + offset), ecv)
+        ea[:] = jnp.where(any_ins, splice(eav, act), eav)
+        er[:] = jnp.where(any_ins, splice(erv, op_rank), erv)
+        dl[:] = jnp.where(any_ins, splice(dlv, 0), dlv)
+        ch[:] = jnp.where(any_ins, splice(chv, char_vals), chv)
+        oi[:] = jnp.where(any_ins, splice(oiv, -1), oiv)
+        ln[:] = lnv + jnp.where(any_ins, k, 0)
         return 0
 
     lax.fori_loop(0, num_ops, body, 0)
@@ -117,10 +148,16 @@ def text_phase_pallas(
     length: jax.Array,  # [R] int32
     text_ops: jax.Array,  # [R, L, OP_FIELDS] int32
     ranks: jax.Array,  # [A] int32
+    char_buf: jax.Array | None = None,  # [R, BUF] int32 run chars
     interpret: bool | None = None,
 ):
     """Run the text phase in VMEM.  Returns the updated element arrays plus
-    the orig-index permutation plane for boundary-table realignment."""
+    the orig-index permutation plane for boundary-table realignment.
+
+    ``char_buf`` carries the side buffer for fused KIND_INSERT_RUN rows
+    (encode.fuse_insert_runs); without it, run rows are rejected loudly
+    rather than silently dropped (concrete inputs only — under an outer jit
+    the caller must pass the buffer whenever runs can occur)."""
     interpret = _resolve_interpret(interpret)
     r, c = elem_ctr.shape
     num_ops = text_ops.shape[1]
@@ -128,6 +165,33 @@ def text_phase_pallas(
         raise ValueError(f"replica count {r} must be a multiple of {REPLICA_BLOCK}")
     if c % 128 != 0:
         raise ValueError(f"capacity {c} must be a multiple of 128")
+    if c & (c - 1):
+        raise ValueError(f"capacity {c} must be a power of two")
+    if char_buf is None:
+        if isinstance(text_ops, jax.core.Tracer):
+            # Under an outer jit the rows can't be inspected, and a zero
+            # buffer would splice NUL characters for any fused run — require
+            # the caller to be explicit (pass zeros if runs are impossible).
+            raise ValueError(
+                "char_buf is required when text_ops is traced; pass "
+                "encode.fuse_insert_runs' buffer (or explicit zeros if no "
+                "KIND_INSERT_RUN rows can occur)"
+            )
+        import numpy as np
+
+        if (np.asarray(text_ops)[..., K.K_KIND] == K.KIND_INSERT_RUN).any():
+            raise ValueError(
+                "text_ops contain KIND_INSERT_RUN rows but no char_buf "
+                "was given; pass encode.fuse_insert_runs' buffer"
+            )
+        char_buf = jnp.zeros((r, c), jnp.int32)
+    # The char plane must span >= C lanes so every block lane can read its
+    # run character after the per-row alignment roll (see _text_kernel).
+    w2 = max(c, char_buf.shape[1])
+    if w2 & (w2 - 1):
+        raise ValueError(f"char buffer width {char_buf.shape[1]} must be a power of two")
+    if char_buf.shape[1] < w2:
+        char_buf = jnp.pad(char_buf, ((0, 0), (0, w2 - char_buf.shape[1])))
 
     elem_rank = ranks[elem_act]
     orig_idx = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32), (r, c))
@@ -144,18 +208,20 @@ def text_phase_pallas(
     b = REPLICA_BLOCK
     row_spec = pl.BlockSpec((b, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
     ops_spec = pl.BlockSpec((b, num_ops * OPF), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    cb_spec = pl.BlockSpec((b, w2), lambda i: (i, 0), memory_space=pltpu.VMEM)
     len_spec = pl.BlockSpec((b, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
     shape = jax.ShapeDtypeStruct((r, c), jnp.int32)
 
     outs = pl.pallas_call(
-        functools.partial(_text_kernel, num_ops=num_ops),
+        functools.partial(_text_kernel, num_ops=num_ops, w2=w2),
         grid=(r // b,),
-        in_specs=[ops_spec] + [row_spec] * 6 + [len_spec],
+        in_specs=[ops_spec, cb_spec] + [row_spec] * 6 + [len_spec],
         out_specs=[row_spec] * 6 + [len_spec],
         out_shape=[shape] * 6 + [jax.ShapeDtypeStruct((r, 1), jnp.int32)],
         interpret=interpret,
     )(
         ops_ext,
+        char_buf,
         elem_ctr,
         elem_act,
         elem_rank,
@@ -382,7 +448,9 @@ def mark_phase_pallas(
     return new_def.astype(bool), new_mask
 
 
-def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool | None = None):
+def merge_step_pallas_full(
+    states, text_ops, mark_ops, ranks, char_buf=None, interpret: bool | None = None
+):
     """Fully VMEM-resident merge: Pallas text phase + permute + Pallas mark
     phase + device table append.  State-equivalent to merge_step."""
     ec, ea, dl, ch, oi, ln = text_phase_pallas(
@@ -393,6 +461,7 @@ def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool | 
         states.length,
         text_ops,
         ranks,
+        char_buf=char_buf,
         interpret=interpret,
     )
     bnd_def, bnd_mask = jax.vmap(K._permute_boundaries)(
@@ -415,7 +484,9 @@ def merge_step_pallas_full(states, text_ops, mark_ops, ranks, interpret: bool | 
     return _update_mark_table(out, mark_ops)
 
 
-def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool | None = None):
+def merge_step_pallas(
+    states, text_ops, mark_ops, ranks, char_buf=None, interpret: bool | None = None
+):
     """Fast merge with the Pallas text phase: VMEM-resident text application,
     then the standard boundary permute + mark phase (kernels.merge_step's
     tail), batched over replicas."""
@@ -427,6 +498,7 @@ def merge_step_pallas(states, text_ops, mark_ops, ranks, interpret: bool | None 
         states.length,
         text_ops,
         ranks,
+        char_buf=char_buf,
         interpret=interpret,
     )
 

@@ -222,8 +222,8 @@ class TpuUniverse:
 
     # -- the causal gate (host) --------------------------------------------
 
-    def _gate(self, r: int, changes: Sequence[Change]) -> List[Change]:
-        """Order + validate a change batch against replica r's clock.
+    def _gate(self, clock: Dict[str, int], changes: Sequence[Change]) -> List[Change]:
+        """Order + validate a change batch against a replica clock.
 
         Single-pass equivalent of the reference's applyChange seq/deps gate
         (micromerge.ts:501-509) + the retry loop (test/merge.ts:4-23).
@@ -231,8 +231,12 @@ class TpuUniverse:
         (causal_order), because patch streams are order-sensitive and must
         match what an incremental replica consuming the same delivery order
         would emit.  Duplicate (already-seen) changes drop idempotently.
+
+        ``clock`` is mutated in place; callers pass a *copy* of the replica
+        clock and commit it back only after the device launch succeeds, so a
+        gate failure on one replica (or a failed launch) can never leave
+        another replica's clock claiming changes its state never received.
         """
-        clock = self.clocks[r]
         seen = set()
         fresh = []
         for c in changes:
@@ -242,11 +246,61 @@ class TpuUniverse:
                 fresh.append(c)
             else:
                 self.stats["duplicates_dropped"] += 1
-        self.stats["changes_ingested"] += len(fresh)
         ordered = causal_order(fresh, clock)
         for change in ordered:
             clock[change["actor"]] = change["seq"]
         return ordered
+
+    def _prepare(
+        self, batches: List[Sequence[Change]]
+    ) -> Dict[str, Any]:
+        """Gate + encode every replica without touching committed state.
+
+        Raises before any commit if any replica's batch is causally
+        unsatisfiable; on success returns everything the launch and the
+        post-launch commit need.
+        """
+        new_clocks: List[Dict[str, int]] = []
+        rows_list: List[np.ndarray] = []
+        host_ops_list: List[List[Dict[str, Any]]] = []
+        ins_counts: List[int] = []
+        mk_counts: List[int] = []
+        n_ingested = 0
+        for r, changes in enumerate(batches):
+            clock = dict(self.clocks[r]) if changes else self.clocks[r]
+            ordered = self._gate(clock, changes)
+            n_ingested += len(ordered)
+            rows, host_ops, counts = encode_changes(
+                ordered,
+                self.actors,
+                self.attrs,
+                text_obj=self.roots[r].get("__lists__", {}).get("text"),
+            )
+            new_clocks.append(clock)
+            rows_list.append(rows)
+            host_ops_list.append(host_ops)
+            ins_counts.append(counts["insert"])
+            mk_counts.append(counts["mark"])
+        n = len(batches)
+        return {
+            "clocks": new_clocks,
+            "rows": rows_list,
+            "host_ops": host_ops_list,
+            "inserts": ins_counts,
+            "marks": mk_counts,
+            "ingested": n_ingested,
+            "need_len": max((self.lengths[r] + ins_counts[r] for r in range(n)), default=0),
+            "need_marks": max((self.mark_counts[r] + mk_counts[r] for r in range(n)), default=0),
+        }
+
+    def _commit(self, prep: Dict[str, Any]) -> None:
+        """Publish a prepared batch's control-plane effects (post-launch)."""
+        for r in range(len(self.replica_ids)):
+            self.clocks[r] = prep["clocks"][r]
+            self.lengths[r] += prep["inserts"][r]
+            self.mark_counts[r] += prep["marks"][r]
+            self._apply_host_ops(r, prep["host_ops"][r])
+        self.stats["changes_ingested"] += prep["ingested"]
 
     # -- ingestion ----------------------------------------------------------
 
@@ -264,20 +318,22 @@ class TpuUniverse:
         return batches
 
     def apply_changes(self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]) -> None:
-        """Apply a batch of changes to each named replica in one device launch."""
+        """Apply a batch of changes to each named replica in one device launch.
+
+        Gate+encode run first for *all* replicas against clock copies; the
+        control plane (clocks, lengths, host roots) commits only after the
+        device launch, so a causally-unready change in one replica's batch
+        can never strand another replica's clock ahead of its device state.
+        """
         batches = self._normalize_batches(per_replica)
+        prep = self._prepare(batches)
 
         text_batches: List[np.ndarray] = []
         mark_batches: List[np.ndarray] = []
         char_bufs: List[np.ndarray] = []
         max_text = max_mark = max_buf = 0
         any_rows = False
-        for r, changes in enumerate(batches):
-            ordered = self._gate(r, changes)
-            rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
-            self._apply_host_ops(r, host_ops)
-            self.lengths[r] += counts["insert"]
-            self.mark_counts[r] += counts["mark"]
+        for rows in prep["rows"]:
             any_rows = any_rows or rows.shape[0] > 0
             self.stats["ops_applied"] += int(rows.shape[0])
             text_rows, mark_rows = split_rows(rows)
@@ -289,8 +345,9 @@ class TpuUniverse:
             max_mark = max(max_mark, mark_rows.shape[0])
             max_buf = max(max_buf, char_buf.shape[0])
 
-        self._ensure_capacity(max(self.lengths, default=0), max(self.mark_counts, default=0))
+        self._ensure_capacity(prep["need_len"], prep["need_marks"])
         if not any_rows:
+            self._commit(prep)
             return
         text_pad = bucket_length(max(max_text, 1))
         mark_pad = bucket_length(max(max_mark, 1))
@@ -311,6 +368,7 @@ class TpuUniverse:
             jax.numpy.asarray(ranks),
             jax.numpy.asarray(bufs),
         )
+        self._commit(prep)
 
     def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:
         """Structural map ops (makeList/makeMap/set/del on the root map).
@@ -334,31 +392,28 @@ class TpuUniverse:
         stream per replica (micromerge.ts:25-30).  Uses the faithful
         interleaved per-op path; the patch-free fast path is apply_changes."""
         batches = self._normalize_batches(per_replica)
+        prep = self._prepare(batches)
 
         encoded: List[np.ndarray] = []
         makelist_patches: List[List[Dict[str, Any]]] = []
         max_rows = 0
-        for r, changes in enumerate(batches):
-            ordered = self._gate(r, changes)
-            rows, host_ops, counts = encode_changes(ordered, self.actors, self.attrs)
+        for r, rows in enumerate(prep["rows"]):
             self.stats["ops_applied"] += int(rows.shape[0])
-            self._apply_host_ops(r, host_ops)
             mk = [
                 {**op, "path": ["text"]}
-                for op in host_ops
+                for op in prep["host_ops"][r]
                 if op["action"] == "makeList"
             ]
             makelist_patches.append(mk)
-            self.lengths[r] += counts["insert"]
-            self.mark_counts[r] += counts["mark"]
             encoded.append(rows)
             max_rows = max(max_rows, rows.shape[0])
 
-        self._ensure_capacity(max(self.lengths, default=0), max(self.mark_counts, default=0))
+        self._ensure_capacity(prep["need_len"], prep["need_marks"])
         out: Dict[str, List[Dict[str, Any]]] = {
             name: list(makelist_patches[r]) for r, name in enumerate(self.replica_ids)
         }
         if max_rows == 0:
+            self._commit(prep)
             return out
         pad = bucket_length(max_rows)
         ops = np.stack([pad_rows(rows, pad) for rows in encoded])
@@ -371,6 +426,7 @@ class TpuUniverse:
             jax.numpy.asarray(ranks),
             jax.numpy.asarray(allow_multiple_array()),
         )
+        self._commit(prep)
         records = {k: np.asarray(v) for k, v in records.items()}
         for r, name in enumerate(self.replica_ids):
             state = index_state(self.states, r)

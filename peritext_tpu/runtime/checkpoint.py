@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from peritext_tpu import schema
 from peritext_tpu.ids import ActorRegistry
 from peritext_tpu.ops.encode import AttrRegistry
 from peritext_tpu.ops.state import DocState
@@ -50,6 +51,18 @@ def save_universe(uni: TpuUniverse, path: str) -> None:
         "max_actors": uni.max_actors,
         "actors": uni.actors.actors,
         "attrs": uni.attrs.values,
+        # Snapshots index mark types by position in the runtime-extensible
+        # schema registry; persist the registry so a restoring process with
+        # different register_mark_type calls can't silently remap types.
+        "mark_schema": [
+            {
+                "name": name,
+                "inclusive": spec.inclusive,
+                "allow_multiple": spec.allow_multiple,
+                "attr_keys": list(spec.attr_keys),
+            }
+            for name, spec in schema.MARK_SPEC.items()
+        ],
     }
     tmp = path + ".json.tmp"
     with open(tmp, "w") as f:
@@ -57,9 +70,46 @@ def save_universe(uni: TpuUniverse, path: str) -> None:
     os.replace(tmp, path + ".json")
 
 
+def _restore_mark_schema(sidecar: Dict[str, Any]) -> None:
+    """Validate the snapshot's mark registry against the live one.
+
+    Stored mark-type ids are positional, so the snapshot's registry must be
+    a prefix of the current one (same names, same flags, same order).
+    Types the snapshot has beyond the live registry are auto-registered;
+    any mismatch within the shared prefix fails loudly.
+    """
+    saved = sidecar.get("mark_schema")
+    if saved is None:  # pre-schema-sidecar snapshot: assume the core four
+        return
+    live = list(schema.ALL_MARKS)
+    for i, entry in enumerate(saved):
+        if i < len(live):
+            name = live[i]
+            spec = schema.MARK_SPEC[name]
+            if (
+                entry["name"] != name
+                or entry["inclusive"] != spec.inclusive
+                or entry["allow_multiple"] != spec.allow_multiple
+                or tuple(entry["attr_keys"]) != spec.attr_keys
+            ):
+                raise ValueError(
+                    f"snapshot mark schema mismatch at id {i}: snapshot has "
+                    f"{entry['name']!r}, process has {name!r} (or flags differ); "
+                    "register mark types in the same order before restoring"
+                )
+        else:
+            schema.register_mark_type(
+                entry["name"],
+                inclusive=entry["inclusive"],
+                allow_multiple=entry["allow_multiple"],
+                attr_keys=tuple(entry["attr_keys"]),
+            )
+
+
 def load_universe(path: str) -> TpuUniverse:
     with open(path + ".json") as f:
         sidecar = json.load(f)
+    _restore_mark_schema(sidecar)
     uni = TpuUniverse(
         sidecar["replica_ids"],
         capacity=sidecar["capacity"],

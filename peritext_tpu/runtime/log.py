@@ -30,14 +30,30 @@ class ChangeLog:
         queue.append(change)
 
     def record(self, change: Change) -> None:
-        """Append if this change extends the log; ignore if already present."""
+        """Append if this change extends the log; ignore if already present.
+
+        An already-covered seq must match the stored change byte-for-byte:
+        a mismatch means a forked actor history or a corrupted log, which
+        must surface rather than silently drop.
+        """
         queue = self._queues.setdefault(change["actor"], [])
+        if change["seq"] < 1:
+            raise ValueError(
+                f"Invalid seq {change['seq']} for {change['actor']}: seqs are 1-based"
+            )
         if change["seq"] == len(queue) + 1:
             queue.append(change)
         elif change["seq"] > len(queue) + 1:
             raise ValueError(
                 f"Log gap for {change['actor']}: have {len(queue)}, got seq {change['seq']}"
             )
+        else:
+            stored = queue[change["seq"] - 1]
+            if stored != change:
+                raise ValueError(
+                    f"Log conflict for {change['actor']} seq {change['seq']}: "
+                    "incoming change differs from the stored one (forked history?)"
+                )
 
     def clock(self) -> Dict[str, int]:
         return {actor: len(queue) for actor, queue in self._queues.items()}

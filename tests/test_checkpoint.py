@@ -97,3 +97,67 @@ def test_log_only_cold_rebuild_matches_snapshot(tmp_path):
     cold.apply_changes({n: log.all_changes() for n in ("doc1", "doc2")})
     for name in ("doc1", "doc2"):
         assert cold.spans(name) == uni.spans(name)
+
+
+def test_snapshot_persists_mark_schema(tmp_path):
+    """Mark-type ids are positional in the schema registry; the sidecar must
+    carry the registry so restores validate it (round-1 ADVICE)."""
+    import json
+
+    from peritext_tpu import schema
+
+    docs, log, uni = build_session(tmp_path)
+    path = os.path.join(tmp_path, "snap")
+    save_universe(uni, path)
+
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    names = [e["name"] for e in sidecar["mark_schema"]]
+    assert names[:4] == ["strong", "em", "comment", "link"]
+
+    # Flag mismatch within the shared prefix must fail loudly.
+    sidecar["mark_schema"][0]["inclusive"] = False
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+    import pytest
+
+    with pytest.raises(ValueError, match="mark schema mismatch"):
+        load_universe(path)
+
+
+def test_snapshot_restores_registered_mark_types(tmp_path):
+    """A snapshot taken with extra registered types re-registers them on
+    load in a process that hasn't registered them."""
+    import json
+
+    from peritext_tpu import schema
+
+    docs, log, uni = build_session(tmp_path)
+    path = os.path.join(tmp_path, "snap")
+    save_universe(uni, path)
+
+    # Simulate "snapshot from a process with one more registered type" by
+    # appending to the sidecar's schema table.
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    extra = {
+        "name": "ckpt_only_mark",
+        "inclusive": True,
+        "allow_multiple": False,
+        "attr_keys": [],
+    }
+    sidecar["mark_schema"].append(extra)
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+
+    assert "ckpt_only_mark" not in schema.MARK_SPEC
+    try:
+        restored = load_universe(path)
+        assert "ckpt_only_mark" in schema.MARK_SPEC
+        assert schema.MARK_SPEC["ckpt_only_mark"].inclusive is True
+        assert restored.spans("doc1") == uni.spans("doc1")
+    finally:
+        # Keep the process-global registry clean for other tests (and for
+        # reruns of this one — there is deliberately no public unregister).
+        schema.MARK_SPEC.pop("ckpt_only_mark", None)
+        schema._rebuild_views()

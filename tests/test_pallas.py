@@ -36,6 +36,71 @@ def test_pallas_merge_matches_xla(merge_fn, seed):
         assert (a == b).all(), f"field {field.name} diverged"
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pallas_fused_runs_match_xla(seed):
+    """KIND_INSERT_RUN rows (fused typing runs + char buffer) must produce
+    the same state as the XLA fused path — this is the configuration the
+    benchmark runs."""
+    from peritext_tpu.ops.encode import fuse_insert_runs, pad_buffer, pad_rows
+    from peritext_tpu.ops.pallas_kernels import merge_step_pallas_full
+
+    workload = make_merge_workload(
+        doc_len=100, ops_per_merge=32, num_streams=4, with_marks=True, seed=seed
+    )
+    batch = build_device_batch(workload, num_replicas=8, capacity=256, max_mark_ops=64)
+    fused, bufs = [], []
+    for r in range(8):
+        fr, fb = fuse_insert_runs(batch["text_ops"][r])
+        fused.append(fr)
+        bufs.append(fb)
+    text_pad = max(max(f.shape[0] for f in fused), 1)
+    buf_pad = 1
+    while buf_pad < max(max(b.shape[0] for b in bufs), K.MAX_RUN_LEN):
+        buf_pad *= 2
+    fused_text = jnp.asarray(np.stack([pad_rows(f, text_pad) for f in fused]))
+    char_bufs = jnp.asarray(np.stack([pad_buffer(b, buf_pad) for b in bufs]))
+    assert (np.asarray(fused_text)[..., K.K_KIND] == K.KIND_INSERT_RUN).any()
+
+    mark_ops = jnp.asarray(batch["mark_ops"])
+    ranks = jnp.asarray(batch["ranks"])
+    ref = K.merge_step_fused_batch(
+        batch["states"], fused_text, mark_ops, ranks, char_bufs
+    )
+    out = merge_step_pallas_full(
+        batch["states"], fused_text, mark_ops, ranks, char_buf=char_bufs, interpret=True
+    )
+
+    import dataclasses
+
+    for field in dataclasses.fields(ref):
+        a = np.asarray(getattr(ref, field.name))
+        b = np.asarray(getattr(out, field.name))
+        assert (a == b).all(), f"field {field.name} diverged"
+
+
+def test_pallas_run_rows_without_buffer_raise():
+    """A fused-run row with no char buffer must be a loud error, never a
+    silent drop (ADVICE round 1)."""
+    from peritext_tpu.ops.pallas_kernels import text_phase_pallas
+
+    workload = make_merge_workload(doc_len=32, ops_per_merge=8, num_streams=2, seed=0)
+    batch = build_device_batch(workload, num_replicas=8, capacity=128)
+    text_ops = np.array(batch["text_ops"])
+    text_ops[:, 0, K.K_KIND] = K.KIND_INSERT_RUN
+    st = batch["states"]
+    with pytest.raises(ValueError, match="INSERT_RUN"):
+        text_phase_pallas(
+            st.elem_ctr,
+            st.elem_act,
+            st.deleted,
+            st.chars,
+            st.length,
+            jnp.asarray(text_ops),
+            jnp.asarray(batch["ranks"]),
+            interpret=True,
+        )
+
+
 def test_pallas_rejects_misaligned_shapes():
     workload = make_merge_workload(doc_len=20, ops_per_merge=4, num_streams=2, seed=0)
     batch = build_device_batch(workload, num_replicas=6, capacity=128)

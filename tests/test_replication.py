@@ -121,3 +121,17 @@ def test_pubsub_queue_editor_wiring_end_to_end():
         q.flush()
     expected = docs[0].get_text_with_formatting(["text"])
     assert all(d.get_text_with_formatting(["text"]) == expected for d in docs)
+
+
+def test_change_log_record_detects_forked_history():
+    """An already-covered seq must equal the stored change; a conflicting
+    fork or corrupted entry surfaces instead of silently dropping."""
+    doc = Doc("forker")
+    c1, _ = doc.change([{"path": [], "action": "makeList", "key": "text"}])
+    log = ChangeLog()
+    log.record(c1)
+    log.record(dict(c1))  # true duplicate: idempotent
+    assert log.clock() == {"forker": 1}
+    forged = {**c1, "ops": []}
+    with pytest.raises(ValueError, match="conflict"):
+        log.record(forged)

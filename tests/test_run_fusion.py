@@ -13,7 +13,11 @@ from peritext_tpu.testing import generate_docs
 
 def encode_stream(changes):
     actors, attrs = ActorRegistry(), AttrRegistry()
-    rows, _, _ = encode_changes(changes, actors, attrs)
+    # These streams don't carry their genesis change; trust their own obj.
+    text_obj = next(
+        (op.get("obj") for c in changes for op in c["ops"] if op.get("obj")), None
+    )
+    rows, _, _ = encode_changes(changes, actors, attrs, text_obj=text_obj)
     return rows, actors
 
 
