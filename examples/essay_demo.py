@@ -78,6 +78,26 @@ TRACE = (
 )
 
 
+def flash_act():
+    """The reference demo's remote-change flash (essay-demo.ts:47-75):
+    remote edits light up with a temporary highlightChange overlay."""
+    from peritext_tpu.bridge import EditorNetwork, RemoteChangeHighlighter
+
+    net = EditorNetwork(["alice", "bob"], initial_text="Watch remote edits flash.")
+    flash = RemoteChangeHighlighter(net["alice"], duration_ticks=1)
+    net["bob"].insert(6, "incoming ")
+    net["bob"].toggle_mark(0, 5, "strong")
+    net["bob"].sync()
+    print("\nremote-change flash on alice's view:")
+    for span in flash.spans():
+        lit = " <-- flashing" if "highlightChange" in span["marks"] else ""
+        print(f"  {span['text']!r:35}{lit}")
+    flash.tick()
+    assert flash.spans() == net["alice"].spans(), "flash failed to expire"
+    assert net.converged(), "flash act diverged!"
+    print("flash expired; views converged.")
+
+
 def main():
     session = TraceSession(["alice", "bob"])
     session.run(TRACE)
@@ -87,6 +107,7 @@ def main():
     for span in spans["alice"]:
         marks = ",".join(f"{k}={v}" for k, v in span["marks"].items())
         print(f"  {span['text']!r:45} {marks}")
+    flash_act()
 
 
 if __name__ == "__main__":

@@ -12,6 +12,8 @@ import sys
 import time
 from collections import Counter
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -49,6 +51,7 @@ def main() -> None:
     print(f"genesis: {replicas} replicas bootstrapped in {time.perf_counter()-t0:.2f}s")
 
     total_ops = 0
+    wall = 0.0
     for rnd in range(rounds):
         # Each replica merges one writer stream per round, round-robin — so
         # after every round, replicas on the same stream schedule must agree.
@@ -59,8 +62,12 @@ def main() -> None:
             total_ops += sum(len(c["ops"]) for c in stream)
         t0 = time.perf_counter()
         uni.apply_changes(batch)
+        # Host readback barrier: JAX dispatch is async, so round wall time
+        # without a barrier would only measure enqueueing.
+        np.asarray(uni.states.length)
         dt = time.perf_counter() - t0
         print(f"round {rnd}: merged {len(streams)} streams across {replicas} replicas in {dt:.2f}s")
+        wall += dt
 
     # After `rounds` round-robin rounds every replica has seen streams
     # {(i+r) % 4}, so replicas with i % 4 equal share identical histories.
@@ -79,9 +86,15 @@ def main() -> None:
     spans = uni.spans(names[0])
     text = "".join(s["text"] for s in spans)
     marked = sum(1 for s in spans if s["marks"])
+    host_s = uni.stats["host_seconds"]
+    # Device share = barriered round wall time minus the host control plane
+    # (dispatch_seconds alone would miss async execution).
+    dev_s = max(wall - host_s, 0.0)
     print(
         f"\nfleet consistent: {replicas} replicas, {total_ops} ops merged; "
-        f"replica-0: {len(text)} chars in {len(spans)} spans ({marked} marked)"
+        f"replica-0: {len(text)} chars in {len(spans)} spans ({marked} marked)\n"
+        f"time split: host {host_s:.3f}s, device {dev_s:.3f}s of {wall:.3f}s barriered "
+        f"({'host-bound' if host_s > dev_s else 'device-bound'})"
     )
 
 

@@ -21,9 +21,21 @@ def main() -> None:
 
     from peritext_tpu.bench.workloads import time_batched_merge, time_scalar_baseline
 
-    tpu = time_batched_merge(
-        num_replicas=num_replicas, doc_len=doc_len, ops_per_merge=ops_per_merge
-    )
+    profile_dir = os.environ.get("PERITEXT_PROFILE")
+    if profile_dir:
+        # SURVEY §5 observability: capture a device trace of one measured
+        # round (XLA op timeline + HBM traffic on TPU backends).  View with
+        # tensorboard / xprof; the artifact dir is the deliverable.
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            tpu = time_batched_merge(
+                num_replicas=num_replicas, doc_len=doc_len, ops_per_merge=ops_per_merge
+            )
+    else:
+        tpu = time_batched_merge(
+            num_replicas=num_replicas, doc_len=doc_len, ops_per_merge=ops_per_merge
+        )
     scalar = time_scalar_baseline(doc_len=doc_len, ops_per_merge=ops_per_merge)
 
     import jax

@@ -221,6 +221,113 @@ class Editor:
         self.queue.flush()
 
 
+class RemoteChangeHighlighter:
+    """Flash remote edits with temporary highlight marks.
+
+    The reference essay demo (essay-demo.ts:47-75) hooks
+    ``onRemotePatchApplied`` and overlays demo-only ``highlightChange``
+    marks (schema.ts:99-121) on the *view* for a moment — the highlights
+    never enter the CRDT.  This is that flow with the toolkit abstracted:
+    remote patches record flash ranges, ``spans()`` renders the editor's
+    spans with the highlight overlaid, and ``tick()`` expires flashes (the
+    reference uses a timeout).
+    """
+
+    MARK = "highlightChange"
+
+    def __init__(self, editor: Editor, duration_ticks: int = 1) -> None:
+        # Note the overlay mark never enters the CRDT, so it is NOT
+        # registered in the mark schema (registration is for marks that
+        # produce mark *ops* — schema.register_mark_type covers that path).
+        self.editor = editor
+        self.duration = duration_ticks
+        self.flashes: List[Dict[str, int]] = []
+        # Map ranges through every patch (local and remote, the way PM maps
+        # decorations through all transactions); record flashes on remote
+        # ones.  Editor fires on_patch before on_remote_patch, so a remote
+        # patch maps earlier flashes first, then records its own.
+        self._prev_patch_hook = editor.on_patch
+        self._prev_remote_hook = editor.on_remote_patch
+        editor.on_patch = self._on_any_patch
+        editor.on_remote_patch = self._on_remote_patch
+
+    @staticmethod
+    def _patch_range(patch: Patch) -> Optional[Tuple[int, int]]:
+        action = patch.get("action")
+        if action == "insert":
+            return patch["index"], patch["index"] + len(patch["values"])
+        if action in ("addMark", "removeMark"):
+            return patch["startIndex"], patch["endIndex"]
+        return None  # deletes leave nothing on screen to flash
+
+    def _map_through(self, patch: Patch) -> None:
+        """Remap recorded flash ranges through an incoming patch, the way
+        the reference maps decorations through ProseMirror transactions —
+        a later insert/delete in the same sync shifts earlier flashes."""
+        action = patch.get("action")
+        if action == "insert":
+            at, n = patch["index"], len(patch["values"])
+            for f in self.flashes:
+                if f["start"] >= at:
+                    f["start"] += n
+                if f["end"] > at:
+                    f["end"] += n
+        elif action == "delete":
+            at, n = patch["index"], patch.get("count", 1)
+            for f in self.flashes:
+                f["start"] -= min(n, max(0, f["start"] - at))
+                f["end"] -= min(n, max(0, f["end"] - at))
+            self.flashes = [f for f in self.flashes if f["end"] > f["start"]]
+
+    def _on_any_patch(self, patch: Patch) -> None:
+        if self._prev_patch_hook:
+            self._prev_patch_hook(patch)
+        self._map_through(patch)
+
+    def _on_remote_patch(self, patch: Patch) -> None:
+        if self._prev_remote_hook:
+            self._prev_remote_hook(patch)
+        rng = self._patch_range(patch)
+        if rng and rng[1] > rng[0]:
+            self.flashes.append({"start": rng[0], "end": rng[1], "ttl": self.duration})
+
+    def tick(self) -> None:
+        """Advance the flash clock; expired highlights disappear."""
+        for flash in self.flashes:
+            flash["ttl"] -= 1
+        self.flashes = [f for f in self.flashes if f["ttl"] > 0]
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """The editor's spans with active flashes overlaid (view-only)."""
+        base = self.editor.spans()
+        if not self.flashes:
+            return base
+        out: List[Dict[str, Any]] = []
+        pos = 0
+        for span in base:
+            text = span["text"]
+            # Split this span at every flash boundary inside it.
+            cuts = {0, len(text)}
+            for f in self.flashes:
+                for edge in (f["start"], f["end"]):
+                    if pos < edge < pos + len(text):
+                        cuts.add(edge - pos)
+            edges = sorted(cuts)
+            for a, b in zip(edges, edges[1:]):
+                lit = any(
+                    f["start"] < pos + b and pos + a < f["end"] for f in self.flashes
+                )
+                marks = dict(span["marks"])
+                if lit:
+                    marks[self.MARK] = {"active": True}
+                if out and out[-1]["marks"] == marks:
+                    out[-1]["text"] += text[a:b]
+                else:
+                    out.append({"marks": marks, "text": text[a:b]})
+            pos += len(text)
+        return out
+
+
 class EditorNetwork:
     """A set of editors on one shared publisher (the live-demo topology)."""
 

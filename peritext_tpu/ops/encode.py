@@ -241,7 +241,9 @@ def split_rows(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return rows[~is_mark], rows[is_mark]
 
 
-def fuse_insert_runs(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+def fuse_insert_runs(
+    rows: np.ndarray, max_run: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
     """Fuse chained insert rows into KIND_INSERT_RUN rows + a char buffer.
 
     A chain is consecutive rows where each insert references the previous
@@ -249,7 +251,16 @@ def fuse_insert_runs(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     one insert input op expands to (micromerge.ts:351-361).  Chains apply as
     one scan step each (see kernels._apply_text_op's contiguity argument).
     Returns (fused rows, char buffer padded for in-bounds dynamic slices).
+
+    ``max_run`` caps chain length; the default (kernels.MAX_RUN_LEN) is what
+    the scan/Pallas paths' static char windows require.  The sort-based
+    placement path scatters runs with no window, so it fuses unbounded
+    (pass ``max_run=0``) — a whole pasted document is one row.
     """
+    if max_run is None:
+        max_run = K.MAX_RUN_LEN
+    if max_run <= 0:
+        max_run = 1 << 30
     fused: List[np.ndarray] = []
     chars: List[int] = []
     i = 0
@@ -263,7 +274,7 @@ def fuse_insert_runs(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         j = i + 1
         while (
             j < n
-            and j - i < K.MAX_RUN_LEN
+            and j - i < max_run
             and rows[j][K.K_KIND] == K.KIND_INSERT
             and rows[j][K.K_ACT] == rows[j - 1][K.K_ACT]
             and rows[j][K.K_CTR] == rows[j - 1][K.K_CTR] + 1
@@ -289,6 +300,77 @@ def fuse_insert_runs(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     buf = np.zeros(len(chars) + K.MAX_RUN_LEN, np.int32)
     buf[: len(chars)] = chars
     return out_rows, buf
+
+
+def compute_rounds(rows: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Reference-depth labels for sort-based batch placement.
+
+    An op whose reference element pre-exists the batch gets round 0; an op
+    referencing an element *created by an earlier row of this batch* gets
+    that row's round + 1 (it must wait until its reference is placed).
+    Returns (round_of [N] int32, num_rounds).  Causal order guarantees a
+    reference row always precedes its dependents.
+    """
+    n = rows.shape[0]
+    round_of = np.zeros(n, np.int32)
+    if n == 0:
+        return round_of, 1
+    created: Dict[Tuple[int, int], int] = {}
+    kinds = rows[:, K.K_KIND]
+    for i in range(n):
+        kind = kinds[i]
+        if kind == K.KIND_PAD:
+            continue
+        ref = (int(rows[i, K.K_REF_ACT]), int(rows[i, K.K_REF_CTR]))
+        j = created.get(ref)
+        if j is not None:
+            round_of[i] = round_of[j] + 1
+        if kind == K.KIND_INSERT:
+            created[(int(rows[i, K.K_ACT]), int(rows[i, K.K_CTR]))] = i
+        elif kind == K.KIND_INSERT_RUN:
+            act = int(rows[i, K.K_ACT])
+            first = int(rows[i, K.K_CTR])
+            for ctr in range(first, first + int(rows[i, K.K_RUN_LEN])):
+                created[(act, ctr)] = i
+    return round_of, int(round_of.max()) + 1
+
+
+def prepare_sorted_batch(
+    text_rows_list: Sequence[np.ndarray], max_run: int = 0
+) -> Dict[str, Any]:
+    """Shared preparation for the sort-based placement path.
+
+    Fuses insert runs (unbounded by default — placement scatters need no
+    static window), labels reference-depth rounds, and pads/stacks the
+    per-stream row arrays.  Returns a dict with ``text`` [G, L, F],
+    ``rounds`` [G, L], ``bufs`` [G, B], ``num_rounds``, and ``maxk``
+    (bucketed run-length cap for the kernel's static block width).  Used by
+    the universe ingest path, the benchmark, and the differential tests so
+    the three can never diverge.
+    """
+    fused, bufs, round_labels = [], [], []
+    num_rounds, maxk = 1, 1
+    for rows in text_rows_list:
+        fr, fb = fuse_insert_runs(rows, max_run=max_run)
+        ro, nr = compute_rounds(fr)
+        num_rounds = max(num_rounds, nr)
+        runs = fr[:, K.K_KIND] == K.KIND_INSERT_RUN
+        if runs.any():
+            maxk = max(maxk, int(fr[runs, K.K_RUN_LEN].max()))
+        fused.append(fr)
+        bufs.append(fb)
+        round_labels.append(ro)
+    text_pad = bucket_length(max(max(f.shape[0] for f in fused), 1))
+    buf_pad = bucket_length(max(max(b.shape[0] for b in bufs), K.MAX_RUN_LEN))
+    return {
+        "text": np.stack([pad_rows(f, text_pad) for f in fused]),
+        "rounds": np.stack(
+            [np.pad(ro, (0, text_pad - ro.shape[0])) for ro in round_labels]
+        ).astype(np.int32),
+        "bufs": np.stack([pad_buffer(b, buf_pad) for b in bufs]),
+        "num_rounds": num_rounds,
+        "maxk": bucket_length(maxk, minimum=1),
+    }
 
 
 def pad_buffer(buf: np.ndarray, length: int) -> np.ndarray:

@@ -647,6 +647,394 @@ merge_step_fused_vmapped = jax.vmap(merge_step, in_axes=(0, 0, 0, None, 0))
 merge_step_fused_batch = jax.jit(merge_step_fused_vmapped)
 
 
+# ---------------------------------------------------------------------------
+# Sort-based batch integration: place a whole op batch in O(depth) rounds
+# ---------------------------------------------------------------------------
+#
+# The scan paths above keep the reference's asymptotics — L ops cost L
+# sequential O(C) steps.  This path integrates an entire causally-ordered
+# text-op batch in D vectorized rounds, where D is the batch's reference
+# *depth* (how many ops chain through elements created earlier in the same
+# batch — computed on host by encode.compute_rounds; 1 for fully concurrent
+# batches, small in practice because insert runs are pre-fused).
+#
+# Correctness (simultaneous placement == sequential application): for ops
+# whose references all pre-exist the round, sequential RGA application in any
+# causal order equals a stable merge keyed by (t, descending op id), where
+# t(op) = min{ j > idx(ref) : ~(alive_j & id_j > id_op) } is the op's skip-run
+# stop (micromerge.ts:630-635) computed against the *pre-round* array:
+# - same t, any refs: the later-applied op's scan stops exactly at (greater
+#   id) or immediately before (smaller id) the earlier op's block, which is
+#   the descending-id order.
+# - different t: an op B can only encounter a previously placed block A
+#   inside its skip run when every pre-round element between them exceeds
+#   B's id; A's own stop rule then forces t_A >= t_B unless id_A > id_B, so
+#   a smaller-id block can never land strictly inside B's run — B's stop
+#   element (hence its placement) is unchanged by A, and positions shift by
+#   exactly the blocks placed at or before them.
+# Ops whose reference is created by the batch itself go in a later round
+# (their reference then pre-exists), and rounds respect causal order, so the
+# round decomposition is a causal-order-preserving reordering — which
+# preserves the final state exactly as the two-phase argument above.
+# Deletes never affect placement (the stop rule reads allocation, not
+# tombstones) and apply as one [L, C] masked match per round.
+
+
+def _place_round(carry, r, ops, round_of, ranks, char_buf, maxk: int):
+    """Apply every round-r text op simultaneously (one scatter pass)."""
+    elem_ctr, elem_act, deleted, chars, orig_idx, length = carry
+    c = elem_ctr.shape[0]
+    ar = jnp.arange(c, dtype=jnp.int32)
+    alive = ar < length
+
+    kind = ops[:, K_KIND]
+    active = round_of == r
+    is_ins = active & ((kind == KIND_INSERT) | (kind == KIND_INSERT_RUN))
+    is_run = kind == KIND_INSERT_RUN
+    is_del = active & (kind == KIND_DELETE)
+
+    ref_ctr = ops[:, K_REF_CTR]
+    ref_act = ops[:, K_REF_ACT]
+    ref_match = (
+        alive[None, :]
+        & (elem_ctr[None, :] == ref_ctr[:, None])
+        & (elem_act[None, :] == ref_act[:, None])
+    )  # [L, C]
+
+    # Deletes: tombstone every match in one pass.
+    deleted = deleted | (ref_match & is_del[:, None]).any(axis=0)
+
+    # Insert placement: the shared skip-run stop rule, batched over ops.
+    ctr_i = ops[:, K_CTR]
+    rank_i = ranks[ops[:, K_ACT]]
+    is_head = (ref_ctr == 0) & (ref_act == 0)
+    idx = jnp.where(is_head, jnp.int32(-1), jnp.argmax(ref_match, axis=1).astype(jnp.int32))
+    elem_rank = ranks[elem_act]
+    gt = (elem_ctr[None, :] > ctr_i[:, None]) | (
+        (elem_ctr[None, :] == ctr_i[:, None]) & (elem_rank[None, :] > rank_i[:, None])
+    )  # [L, C]
+    stop = (ar[None, :] > idx[:, None]) & ~(alive[None, :] & gt)
+    t = jnp.min(jnp.where(stop, ar[None, :], c), axis=1).astype(jnp.int32)  # [L]
+
+    k = jnp.where(is_run, ops[:, K_RUN_LEN], 1) * is_ins.astype(jnp.int32)  # [L]
+
+    # Final block starts: stable order (t, descending op id) among the
+    # round's inserts; inactive ops contribute k = 0.
+    id_gt = (ctr_i[None, :] > ctr_i[:, None]) | (
+        (ctr_i[None, :] == ctr_i[:, None]) & (rank_i[None, :] > rank_i[:, None])
+    )  # [L, L]: op j's id > op i's id
+    before = (t[None, :] < t[:, None]) | ((t[None, :] == t[:, None]) & id_gt)
+    s = t + jnp.sum(k[None, :] * before.astype(jnp.int32), axis=1)  # [L]
+
+    # Existing elements shift right by every block placed at or before them.
+    shifts = jnp.sum(k[:, None] * (t[:, None] <= ar[None, :]).astype(jnp.int32), axis=0)
+    dest_exist = jnp.where(alive, ar + shifts, c)  # dead slots drop
+
+    # Op-block values and destinations, [L, maxk].
+    off = jnp.arange(maxk, dtype=jnp.int32)
+    in_block = (off[None, :] < k[:, None]) & is_ins[:, None]
+    dest_ops = jnp.where(in_block, s[:, None] + off[None, :], c)
+    buf_idx = jnp.clip(ops[:, K_PAYLOAD, None] + off[None, :], 0, char_buf.shape[0] - 1)
+    block_chars = jnp.where(
+        is_run[:, None], char_buf[buf_idx], ops[:, K_PAYLOAD, None]
+    )
+    block_ctr = ctr_i[:, None] + off[None, :]
+    block_act = jnp.broadcast_to(ops[:, K_ACT, None], (ops.shape[0], maxk))
+
+    def scat(exist_vals, op_vals, fill):
+        out = jnp.full(c, fill, exist_vals.dtype)
+        out = out.at[dest_exist].set(exist_vals, mode="drop")
+        return out.at[dest_ops].set(op_vals, mode="drop")
+
+    zero_blk = jnp.zeros_like(block_ctr)
+    new_carry = (
+        scat(elem_ctr, block_ctr, 0),
+        scat(elem_act, block_act, 0),
+        scat(deleted.astype(jnp.int32), zero_blk, 0).astype(bool),
+        scat(chars, block_chars, 0),
+        scat(orig_idx, zero_blk - 1, -1),
+        length + jnp.sum(k),
+    )
+    return new_carry
+
+
+def place_text_batch(
+    elem_ctr, elem_act, deleted, chars, length, text_ops, round_of, num_rounds,
+    ranks, char_buf, maxk: int,
+):
+    """Integrate a causally-ordered text-op batch in ``num_rounds`` rounds.
+
+    Returns the updated element arrays plus the orig-index permutation plane
+    (for boundary realignment, as in the two-phase path).  ``num_rounds`` is
+    a traced scalar — one compiled program serves any batch depth.
+    """
+    c = elem_ctr.shape[0]
+    carry = (elem_ctr, elem_act, deleted, chars, jnp.arange(c, dtype=jnp.int32), length)
+    carry = lax.fori_loop(
+        0,
+        num_rounds,
+        lambda r, cry: _place_round(cry, r, text_ops, round_of, ranks, char_buf, maxk),
+        carry,
+    )
+    return carry
+
+
+# Batched mark application.  Sequential dependence between mark ops comes
+# only from two channels: (1) an op's start/end writes *define* slots that
+# later ops' carry lookups can select, and (2) an op's written row becomes
+# the base that later in-range ops OR their bit into.  Both channels have a
+# closed form over the whole batch:
+#
+#   final_row(p) = base(p) | OR{ bit_j : j > last_rebase(p), s_j < p < e_j }
+#
+# where last_rebase(p) is the last op writing p via its start/end slot, and
+# base(p) is the row that op wrote — its carry source's row *frozen at that
+# time*, which expands recursively through (slot, time) parent links.  The
+# recursion is resolved with pointer doubling over the 2M write-nodes
+# (S-node = the row op m writes at its start slot, E-node = at its end
+# slot): each node's accumulated value ORs its own contribution (bit +
+# in-range bits between its parent's time and its own) with its parent
+# chain's.  log2(2M) gather rounds replace the M sequential scan steps.
+
+
+def _apply_marks_batch(
+    bnd_def, bnd_mask, mark_ops, elem_ctr, elem_act, length, mark_count, w_words
+):
+    """Apply a causally-ordered mark-op batch to the boundary tables at once.
+
+    Bit-exact with scanning _apply_mark_fast over the same rows (differential
+    coverage in tests/test_sorted_merge.py).  Returns (bnd_def, bnd_mask).
+    """
+    m_ops = mark_ops.shape[0]
+    c = elem_ctr.shape[0]
+    two_c = 2 * c
+    big = jnp.int32(two_c + 2)
+    midx = jnp.arange(m_ops, dtype=jnp.int32)
+    slots = jnp.arange(two_c, dtype=jnp.int32)
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < length
+
+    valid = mark_ops[:, K_KIND] == KIND_MARK  # [M]
+
+    # Anchor resolution (same rules as _apply_mark_fast, batched).
+    s_match = (
+        live[None, :]
+        & (elem_ctr[None, :] == mark_ops[:, K_SCTR, None])
+        & (elem_act[None, :] == mark_ops[:, K_SACT, None])
+    )
+    s_slot = 2 * jnp.argmax(s_match, axis=1).astype(jnp.int32) + mark_ops[:, K_SKIND]
+    e_match = (
+        live[None, :]
+        & (elem_ctr[None, :] == mark_ops[:, K_ECTR, None])
+        & (elem_act[None, :] == mark_ops[:, K_EACT, None])
+    )
+    e_slot = jnp.where(
+        mark_ops[:, K_EKIND] == 2,
+        big,
+        2 * jnp.argmax(e_match, axis=1).astype(jnp.int32)
+        + jnp.minimum(mark_ops[:, K_EKIND], 1),
+    )
+    e_slot = jnp.where(e_slot == s_slot, big, e_slot)  # same-slot -> endOfText
+
+    # Bit rows: op m's table index is mark_count + (rank among valid rows).
+    mpos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    bit_idx = mark_count + mpos  # [M]
+    word_ar = jnp.arange(w_words, dtype=jnp.int32)
+    B = jnp.where(
+        valid[:, None] & (word_ar[None, :] == bit_idx[:, None] // MASK_WORD_BITS),
+        jnp.uint32(1) << (bit_idx[:, None] % MASK_WORD_BITS).astype(jnp.uint32),
+        jnp.uint32(0),
+    )  # [M, W]
+
+    d0 = bnd_def & (slots < 2 * length)  # defined before the batch
+
+    writes_s = valid & (s_slot < e_slot)
+    writes_e = valid & (e_slot < two_c)
+    WS = writes_s[:, None] & (slots[None, :] == s_slot[:, None])  # [M, 2C]
+    WE = writes_e[:, None] & (slots[None, :] == e_slot[:, None])
+    w_any = WS | WE
+    written_any = w_any.any(axis=0)  # [2C]
+    w_last = jnp.max(jnp.where(w_any, midx[:, None], -1), axis=0)  # [2C]
+    f_first = jnp.min(jnp.where(w_any, midx[:, None], m_ops), axis=0)
+    # First time each slot is defined: -1 = pre-batch, m_ops+1 = never.
+    def_time = jnp.where(
+        d0, jnp.int32(-1), jnp.where(written_any, f_first, jnp.int32(m_ops + 1))
+    )
+
+    in_range = (
+        writes_s[:, None]
+        & (slots[None, :] > s_slot[:, None])
+        & (slots[None, :] < e_slot[:, None])
+    )  # [M, 2C]
+    in_range_t = in_range.T  # [2C, M]
+    w_any_t = w_any.T
+
+    def carry_node(p):  # p [M] target slots -> (q, prev, seg_base)
+        # Nearest slot defined before this op's turn.
+        cand = (slots[None, :] <= p[:, None]) & (def_time[None, :] < midx[:, None])
+        q = jnp.max(jnp.where(cand, slots[None, :], -1), axis=1)  # [M]
+        qc = jnp.maximum(q, 0)
+        # Last batch op writing q before this one (-1: q's row is pre-batch).
+        wq = w_any_t[qc] & (q >= 0)[:, None]  # [M, M]
+        prev_cand = wq & (midx[None, :] < midx[:, None])
+        prev = jnp.max(jnp.where(prev_cand, midx[None, :], -1), axis=1)  # [M]
+        # Bits ORed into q between prev and this op (in-range, defined).
+        seg = in_range_t[qc] & (q >= 0)[:, None]
+        seg = seg & (midx[None, :] > prev[:, None]) & (midx[None, :] < midx[:, None])
+        seg_bits = (seg.astype(jnp.uint32) @ B.astype(jnp.uint32)).astype(jnp.uint32)
+        # Root base: q's pre-batch row when no batch op rebased it first.
+        root_row = jnp.where(
+            ((prev < 0) & (q >= 0))[:, None] & d0[qc][:, None],
+            bnd_mask[qc],
+            jnp.uint32(0),
+        )
+        return q, prev, seg_bits | root_row
+
+    q_s, prev_s, base_s = carry_node(s_slot)
+    e_clamped = jnp.minimum(e_slot, jnp.int32(two_c - 1))
+    q_e, prev_e, base_e = carry_node(e_clamped)
+
+    # Node table: node m = op m's S-write row, node M+m = its E-write row.
+    def parent_node(prev, q):
+        # prev's S node if its start slot is q, else its E node.
+        is_s = s_slot[jnp.maximum(prev, 0)] == q
+        return jnp.where(prev < 0, -1, jnp.where(is_s, prev, prev + m_ops))
+
+    acc = jnp.concatenate([base_s | B, base_e], axis=0)  # [2M, W]
+    ptr = jnp.concatenate([parent_node(prev_s, q_s), parent_node(prev_e, q_e)])
+
+    # Pointer doubling: fold each node's ancestor chain into its value.
+    n_nodes = 2 * m_ops
+    steps = max(1, (n_nodes - 1).bit_length())
+    for _ in range(steps):
+        pc = jnp.maximum(ptr, 0)
+        acc = acc | jnp.where((ptr >= 0)[:, None], acc[pc], jnp.uint32(0))
+        ptr = jnp.where(ptr >= 0, ptr[pc], ptr)
+
+    # Per-slot final rows.
+    wl = jnp.maximum(w_last, 0)
+    node_at = jnp.where(s_slot[wl] == slots, wl, wl + m_ops)
+    rebased_row = acc[node_at]  # [2C, W]
+    base_rows = jnp.where(
+        written_any[:, None], rebased_row, jnp.where(d0[:, None], bnd_mask, jnp.uint32(0))
+    )
+    start_time = jnp.where(written_any, w_last, -1)
+    tail_mask = in_range_t & (midx[None, :] > start_time[:, None])  # [2C, M]
+    tail = (tail_mask.astype(jnp.uint32) @ B.astype(jnp.uint32)).astype(jnp.uint32)
+    touched = written_any | (d0 & tail_mask.any(axis=1))
+    new_mask = jnp.where(touched[:, None], base_rows | tail, bnd_mask)
+    new_def = bnd_def | written_any
+    return new_def, new_mask
+
+
+def _append_mark_table(state_fields, mark_ops, mark_count, m_cap):
+    """Scatter-append a mark batch's rows into the per-replica mark table."""
+    mark_ctr, mark_act, mark_action, mark_type, mark_attr = state_fields
+    valid = mark_ops[:, K_KIND] == KIND_MARK
+    idx = mark_count + jnp.cumsum(valid.astype(jnp.int32)) - 1
+    safe = jnp.where(valid, idx, m_cap)
+
+    def scat(col, field):
+        return col.at[safe].set(mark_ops[:, field], mode="drop")
+
+    return (
+        scat(mark_ctr, K_CTR),
+        scat(mark_act, K_ACT),
+        scat(mark_action, K_MACTION),
+        scat(mark_type, K_MTYPE),
+        scat(mark_attr, K_MATTR),
+        mark_count + valid.sum().astype(jnp.int32),
+    )
+
+
+def merge_step_sorted(
+    state: DocState,
+    text_ops: jax.Array,
+    round_of: jax.Array,
+    num_rounds: jax.Array,
+    mark_ops: jax.Array,
+    ranks: jax.Array,
+    char_buf: jax.Array,
+    maxk: int,
+) -> DocState:
+    """Batched merge, both phases vectorized over the whole op batch.
+
+    State-equivalent to merge_step (same two-phase argument); the text phase
+    costs O(depth) vectorized rounds instead of O(#text ops) scan steps, and
+    the mark phase costs O(log #marks) gather rounds instead of one scan
+    step per mark op.
+    """
+    elem_ctr, elem_act, deleted, chars, orig_idx, length = place_text_batch(
+        state.elem_ctr,
+        state.elem_act,
+        state.deleted,
+        state.chars,
+        state.length,
+        text_ops,
+        round_of,
+        num_rounds,
+        ranks,
+        char_buf,
+        maxk,
+    )
+    bnd_def, bnd_mask = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+
+    bnd_def, bnd_mask = _apply_marks_batch(
+        bnd_def,
+        bnd_mask,
+        mark_ops,
+        elem_ctr,
+        elem_act,
+        length,
+        state.mark_count,
+        state.bnd_mask.shape[-1],
+    )
+    mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count = _append_mark_table(
+        (state.mark_ctr, state.mark_act, state.mark_action, state.mark_type, state.mark_attr),
+        mark_ops,
+        state.mark_count,
+        state.max_mark_ops,
+    )
+
+    return DocState(
+        elem_ctr=elem_ctr,
+        elem_act=elem_act,
+        deleted=deleted,
+        chars=chars,
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=mark_ctr,
+        mark_act=mark_act,
+        mark_action=mark_action,
+        mark_type=mark_type,
+        mark_attr=mark_attr,
+        length=length,
+        mark_count=mark_count,
+    )
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _merge_step_sorted_batch(maxk: int):
+    return jax.jit(
+        jax.vmap(
+            _functools.partial(merge_step_sorted, maxk=maxk),
+            in_axes=(0, 0, 0, None, 0, None, 0),
+        )
+    )
+
+
+def merge_step_sorted_batch(
+    states, text_ops, round_of, num_rounds, mark_ops, ranks, char_buf, maxk: int
+):
+    """Jitted batched entry point; one cache entry per maxk bucket."""
+    return _merge_step_sorted_batch(maxk)(
+        states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks, char_buf
+    )
+
+
 def flatten_sources(state: DocState):
     """Per-element effective boundary bitset, for materialization.
 
@@ -675,6 +1063,7 @@ def flatten_sources(state: DocState):
 
 
 flatten_sources_jit = jax.jit(flatten_sources)
+flatten_sources_batch = jax.jit(jax.vmap(flatten_sources))
 
 
 def cursor_elem(state: DocState, index: jax.Array):

@@ -26,9 +26,8 @@ from peritext_tpu.ops.encode import (
     AttrRegistry,
     bucket_length,
     encode_changes,
-    fuse_insert_runs,
-    pad_buffer,
     pad_rows,
+    prepare_sorted_batch,
     split_rows,
 )
 from peritext_tpu.ops.state import (
@@ -38,7 +37,7 @@ from peritext_tpu.ops.state import (
     make_empty_state,
     stack_states,
 )
-from peritext_tpu.oracle.doc import add_characters_to_spans, ops_to_marks
+from peritext_tpu.oracle.doc import ops_to_marks
 from peritext_tpu.runtime.sync import causal_order
 from peritext_tpu import schema
 from peritext_tpu.schema import allow_multiple_array
@@ -184,13 +183,22 @@ class TpuUniverse:
         # Lightweight observability counters (the reference's observability
         # is console logging + the demo op panel, SURVEY §5; at batch scale
         # these are what perf debugging needs).
-        self.stats: Dict[str, int] = {
+        self.stats: Dict[str, Any] = {
             "launches": 0,
             "ops_applied": 0,
             "rows_padded": 0,
             "capacity_growths": 0,
             "changes_ingested": 0,
             "duplicates_dropped": 0,
+            # Wall-clock split of apply_changes: host control plane
+            # (gate/encode/fuse/pad/commit) vs launch *dispatch*.  JAX
+            # dispatch is async — device execution lands on whichever later
+            # readback blocks — so dispatch_seconds is NOT device time;
+            # measure device cost with an explicit readback barrier (the
+            # fleet demo does).  At fleet scale the host share must stay
+            # below the measured device share (BASELINE configs 4-5).
+            "host_seconds": 0.0,
+            "dispatch_seconds": 0.0,
         }
 
     # -- capacity management ------------------------------------------------
@@ -239,68 +247,100 @@ class TpuUniverse:
         """
         seen = set()
         fresh = []
+        dupes = 0
         for c in changes:
             key = (c["actor"], c["seq"])
             if c["seq"] > clock.get(c["actor"], 0) and key not in seen:
                 seen.add(key)
                 fresh.append(c)
             else:
-                self.stats["duplicates_dropped"] += 1
+                dupes += 1
         ordered = causal_order(fresh, clock)
         for change in ordered:
             clock[change["actor"]] = change["seq"]
-        return ordered
+        return ordered, dupes
 
-    def _prepare(
-        self, batches: List[Sequence[Change]]
-    ) -> Dict[str, Any]:
+    def _prepare(self, batches: List[Sequence[Change]]) -> Dict[str, Any]:
         """Gate + encode every replica without touching committed state.
 
         Raises before any commit if any replica's batch is causally
         unsatisfiable; on success returns everything the launch and the
         post-launch commit need.
+
+        Fleet-scale shape: thousands of replicas commonly ingest the *same*
+        change stream from the same clock (fleet_demo, the bench, catch-up
+        sync).  Gate + encode are therefore memoized per distinct
+        (batch identity, clock state, text object) group — the per-replica
+        output is a group index, and the expensive Python/string work runs
+        once per group instead of once per replica.
         """
-        new_clocks: List[Dict[str, int]] = []
-        rows_list: List[np.ndarray] = []
-        host_ops_list: List[List[Dict[str, Any]]] = []
-        ins_counts: List[int] = []
-        mk_counts: List[int] = []
+        n = len(batches)
+        groups: List[Dict[str, Any]] = []
+        memo: Dict[Any, int] = {}
+        group_of = np.zeros(n, np.int32)
         n_ingested = 0
         for r, changes in enumerate(batches):
-            clock = dict(self.clocks[r]) if changes else self.clocks[r]
-            ordered = self._gate(clock, changes)
-            n_ingested += len(ordered)
-            rows, host_ops, counts = encode_changes(
-                ordered,
-                self.actors,
-                self.attrs,
-                text_obj=self.roots[r].get("__lists__", {}).get("text"),
+            clock = self.clocks[r]
+            text_obj = self.roots[r].get("__lists__", {}).get("text")
+            key = (
+                tuple(map(id, changes)),
+                tuple(sorted(clock.items())),
+                text_obj,
             )
-            new_clocks.append(clock)
-            rows_list.append(rows)
-            host_ops_list.append(host_ops)
-            ins_counts.append(counts["insert"])
-            mk_counts.append(counts["mark"])
-        n = len(batches)
+            gi = memo.get(key)
+            if gi is None:
+                new_clock = dict(clock) if changes else clock
+                ordered, dupes = self._gate(new_clock, changes)
+                rows, host_ops, counts = encode_changes(
+                    ordered, self.actors, self.attrs, text_obj=text_obj
+                )
+                gi = len(groups)
+                memo[key] = gi
+                groups.append(
+                    {
+                        "clock": new_clock,
+                        "ordered": ordered,
+                        "dupes": dupes,
+                        "rows": rows,
+                        "host_ops": host_ops,
+                        "inserts": counts["insert"],
+                        "marks": counts["mark"],
+                    }
+                )
+            n_ingested += len(groups[gi]["ordered"])
+            group_of[r] = gi
+        ins = np.asarray([g["inserts"] for g in groups], np.int64)[group_of]
+        mks = np.asarray([g["marks"] for g in groups], np.int64)[group_of]
+        lengths = np.asarray(self.lengths, np.int64) + ins
+        mark_counts = np.asarray(self.mark_counts, np.int64) + mks
         return {
-            "clocks": new_clocks,
-            "rows": rows_list,
-            "host_ops": host_ops_list,
-            "inserts": ins_counts,
-            "marks": mk_counts,
+            "groups": groups,
+            "group_of": group_of,
+            "new_lengths": lengths,
+            "new_mark_counts": mark_counts,
             "ingested": n_ingested,
-            "need_len": max((self.lengths[r] + ins_counts[r] for r in range(n)), default=0),
-            "need_marks": max((self.mark_counts[r] + mk_counts[r] for r in range(n)), default=0),
+            "need_len": int(lengths.max(initial=0)),
+            "need_marks": int(mark_counts.max(initial=0)),
         }
 
     def _commit(self, prep: Dict[str, Any]) -> None:
         """Publish a prepared batch's control-plane effects (post-launch)."""
+        groups = prep["groups"]
+        group_of = prep["group_of"]
+        self.lengths = [int(v) for v in prep["new_lengths"]]
+        self.mark_counts = [int(v) for v in prep["new_mark_counts"]]
         for r in range(len(self.replica_ids)):
-            self.clocks[r] = prep["clocks"][r]
-            self.lengths[r] += prep["inserts"][r]
-            self.mark_counts[r] += prep["marks"][r]
-            self._apply_host_ops(r, prep["host_ops"][r])
+            g = groups[group_of[r]]
+            if g["ordered"]:
+                # Each replica owns its clock dict (sharing one dict across a
+                # group would alias later per-replica clock mutations).
+                self.clocks[r] = dict(g["clock"])
+            if g["host_ops"]:
+                self._apply_host_ops(r, g["host_ops"])
         self.stats["changes_ingested"] += prep["ingested"]
+        sizes = np.bincount(group_of, minlength=len(groups))
+        dupes = np.asarray([g["dupes"] for g in groups], np.int64)
+        self.stats["duplicates_dropped"] += int((dupes * sizes).sum())
 
     # -- ingestion ----------------------------------------------------------
 
@@ -324,51 +364,82 @@ class TpuUniverse:
         control plane (clocks, lengths, host roots) commits only after the
         device launch, so a causally-unready change in one replica's batch
         can never strand another replica's clock ahead of its device state.
+
+        Text ops integrate via sort-based placement (kernels.
+        merge_step_sorted): unbounded insert-run fusion, then the whole
+        batch places in O(reference depth) vectorized rounds instead of one
+        scan step per op.  Set PERITEXT_MERGE_PATH=scan to force the
+        sequential two-phase scan path (debugging/differential runs).
         """
+        import os
+        import time as _time
+
+        t_host = _time.perf_counter()
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
+        groups, group_of = prep["groups"], prep["group_of"]
+        use_scan = os.environ.get("PERITEXT_MERGE_PATH") == "scan"
 
-        text_batches: List[np.ndarray] = []
-        mark_batches: List[np.ndarray] = []
-        char_bufs: List[np.ndarray] = []
-        max_text = max_mark = max_buf = 0
+        # Split once per distinct group; replicas sharing a stream share it.
         any_rows = False
-        for rows in prep["rows"]:
-            any_rows = any_rows or rows.shape[0] > 0
-            self.stats["ops_applied"] += int(rows.shape[0])
-            text_rows, mark_rows = split_rows(rows)
-            text_rows, char_buf = fuse_insert_runs(text_rows)
-            text_batches.append(text_rows)
-            mark_batches.append(mark_rows)
-            char_bufs.append(char_buf)
-            max_text = max(max_text, text_rows.shape[0])
+        text_rows_list: List[np.ndarray] = []
+        mark_rows_list: List[np.ndarray] = []
+        max_mark = 0
+        for g in groups:
+            any_rows = any_rows or g["rows"].shape[0] > 0
+            text_rows, mark_rows = split_rows(g["rows"])
+            text_rows_list.append(text_rows)
+            mark_rows_list.append(mark_rows)
             max_mark = max(max_mark, mark_rows.shape[0])
-            max_buf = max(max_buf, char_buf.shape[0])
+        group_sizes = np.bincount(group_of, minlength=len(groups))
+        row_counts = np.asarray([g["rows"].shape[0] for g in groups], np.int64)
+        self.stats["ops_applied"] += int((row_counts * group_sizes).sum())
 
         self._ensure_capacity(prep["need_len"], prep["need_marks"])
         if not any_rows:
             self._commit(prep)
             return
-        text_pad = bucket_length(max(max_text, 1))
+        sorted_prep = prepare_sorted_batch(
+            text_rows_list, max_run=K.MAX_RUN_LEN if use_scan else 0
+        )
         mark_pad = bucket_length(max(max_mark, 1))
-        buf_pad = bucket_length(max(max_buf, K.MAX_RUN_LEN))
-        text_ops = np.stack([pad_rows(rows, text_pad) for rows in text_batches])
-        mark_ops = np.stack([pad_rows(rows, mark_pad) for rows in mark_batches])
-        bufs = np.stack([pad_buffer(buf, buf_pad) for buf in char_bufs])
+        g_mark = np.stack([pad_rows(rows, mark_pad) for rows in mark_rows_list])
+        # One vectorized gather expands groups to the replica batch.
+        text_ops = sorted_prep["text"][group_of]
+        mark_ops = g_mark[group_of]
+        bufs = sorted_prep["bufs"][group_of]
+        rounds = sorted_prep["rounds"][group_of]
         ranks = self._ranks()
         self.stats["launches"] += 1
-        self.stats["rows_padded"] += int(
-            (text_ops[:, :, K.K_KIND] == K.KIND_PAD).sum()
-            + (mark_ops[:, :, K.K_KIND] == K.KIND_PAD).sum()
-        )
-        self.states = K.merge_step_fused_batch(
-            self.states,
-            jax.numpy.asarray(text_ops),
-            jax.numpy.asarray(mark_ops),
-            jax.numpy.asarray(ranks),
-            jax.numpy.asarray(bufs),
-        )
+        pad_per_group = (sorted_prep["text"][:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1) + (
+            g_mark[:, :, K.K_KIND] == K.KIND_PAD
+        ).sum(axis=1)
+        self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
+        t_dev = _time.perf_counter()
+        self.stats["host_seconds"] += t_dev - t_host
+        if use_scan:
+            self.states = K.merge_step_fused_batch(
+                self.states,
+                jax.numpy.asarray(text_ops),
+                jax.numpy.asarray(mark_ops),
+                jax.numpy.asarray(ranks),
+                jax.numpy.asarray(bufs),
+            )
+        else:
+            self.states = K.merge_step_sorted_batch(
+                self.states,
+                jax.numpy.asarray(text_ops),
+                jax.numpy.asarray(rounds),
+                sorted_prep["num_rounds"],
+                jax.numpy.asarray(mark_ops),
+                jax.numpy.asarray(ranks),
+                jax.numpy.asarray(bufs),
+                sorted_prep["maxk"],
+            )
+        self.stats["dispatch_seconds"] += _time.perf_counter() - t_dev
+        t_host = _time.perf_counter()
         self._commit(prep)
+        self.stats["host_seconds"] += _time.perf_counter() - t_host
 
     def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:
         """Structural map ops (makeList/makeMap/set/del on the root map).
@@ -393,33 +464,34 @@ class TpuUniverse:
         interleaved per-op path; the patch-free fast path is apply_changes."""
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
+        groups, group_of = prep["groups"], prep["group_of"]
 
-        encoded: List[np.ndarray] = []
-        makelist_patches: List[List[Dict[str, Any]]] = []
-        max_rows = 0
-        for r, rows in enumerate(prep["rows"]):
-            self.stats["ops_applied"] += int(rows.shape[0])
-            mk = [
+        for g in groups:
+            g["makelist"] = [
                 {**op, "path": ["text"]}
-                for op in prep["host_ops"][r]
+                for op in g["host_ops"]
                 if op["action"] == "makeList"
             ]
-            makelist_patches.append(mk)
-            encoded.append(rows)
-            max_rows = max(max_rows, rows.shape[0])
+        group_sizes = np.bincount(group_of, minlength=len(groups))
+        row_counts = np.asarray([g["rows"].shape[0] for g in groups], np.int64)
+        self.stats["ops_applied"] += int((row_counts * group_sizes).sum())
+        max_rows = int(row_counts.max(initial=0))
 
         self._ensure_capacity(prep["need_len"], prep["need_marks"])
         out: Dict[str, List[Dict[str, Any]]] = {
-            name: list(makelist_patches[r]) for r, name in enumerate(self.replica_ids)
+            name: list(groups[group_of[r]]["makelist"])
+            for r, name in enumerate(self.replica_ids)
         }
         if max_rows == 0:
             self._commit(prep)
             return out
         pad = bucket_length(max_rows)
-        ops = np.stack([pad_rows(rows, pad) for rows in encoded])
+        g_ops = np.stack([pad_rows(g["rows"], pad) for g in groups])
+        ops = g_ops[group_of]
         ranks = self._ranks()
         self.stats["launches"] += 1
-        self.stats["rows_padded"] += int((ops[:, :, K.K_KIND] == K.KIND_PAD).sum())
+        pad_per_group = (g_ops[:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1)
+        self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
         self.states, records = K.apply_ops_patched_batch(
             self.states,
             jax.numpy.asarray(ops),
@@ -436,15 +508,16 @@ class TpuUniverse:
 
     # -- materialization ----------------------------------------------------
 
-    def _mark_op_table(self, state: DocState) -> Dict[str, Dict[str, Any]]:
-        n = int(state.mark_count)
-        ctr = np.asarray(state.mark_ctr[:n])
-        act = np.asarray(state.mark_act[:n])
-        action = np.asarray(state.mark_action[:n])
-        mtype = np.asarray(state.mark_type[:n])
-        attr = np.asarray(state.mark_attr[:n])
+    def _build_mark_table(
+        self,
+        ctr: np.ndarray,
+        act: np.ndarray,
+        action: np.ndarray,
+        mtype: np.ndarray,
+        attr: np.ndarray,
+    ) -> Dict[str, Dict[str, Any]]:
         table: Dict[str, Dict[str, Any]] = {}
-        for m in range(n):
+        for m in range(ctr.shape[0]):
             op_id = make_op_id(int(ctr[m]), self.actors.actor(int(act[m])))
             op: Dict[str, Any] = {
                 "opId": op_id,
@@ -456,6 +529,108 @@ class TpuUniverse:
                 op["attrs"] = attrs
             table[op_id] = op
         return table
+
+    def _mark_op_table(self, state: DocState) -> Dict[str, Dict[str, Any]]:
+        n = int(state.mark_count)
+        return self._build_mark_table(
+            np.asarray(state.mark_ctr[:n]),
+            np.asarray(state.mark_act[:n]),
+            np.asarray(state.mark_action[:n]),
+            np.asarray(state.mark_type[:n]),
+            np.asarray(state.mark_attr[:n]),
+        )
+
+    def _batch_mark_op_table(self) -> List[Dict[str, Dict[str, Any]]]:
+        """Per-replica mark tables from one batched readback, deduped so
+        replicas with identical tables share one decoded object (the common
+        fleet case — and the dedup key is what lets span decoding share its
+        resolution cache across the batch)."""
+        ctr = np.asarray(self.states.mark_ctr)
+        act = np.asarray(self.states.mark_act)
+        action = np.asarray(self.states.mark_action)
+        mtype = np.asarray(self.states.mark_type)
+        attr = np.asarray(self.states.mark_attr)
+        counts = np.asarray(self.states.mark_count)
+        cache: Dict[bytes, Dict[str, Dict[str, Any]]] = {}
+        tables = []
+        for r in range(len(self.replica_ids)):
+            n = int(counts[r])
+            key = b"".join(
+                a[r, :n].tobytes() for a in (ctr, act, action, mtype, attr)
+            )
+            t = cache.get(key)
+            if t is None:
+                t = self._build_mark_table(
+                    ctr[r, :n], act[r, :n], action[r, :n], mtype[r, :n], attr[r, :n]
+                )
+                cache[key] = t
+            tables.append(t)
+        return tables
+
+    @staticmethod
+    def _codepoints_to_str(codepoints: np.ndarray) -> str:
+        """Vectorized codepoint-array -> str (no per-char Python loop)."""
+        return codepoints.astype("<u4").tobytes().decode("utf-32-le")
+
+    def _spans_from_arrays(
+        self,
+        mask_np: np.ndarray,
+        has_np: np.ndarray,
+        deleted: np.ndarray,
+        chars: np.ndarray,
+        table: Dict[str, Dict[str, Any]],
+        mark_cache: Optional[Dict[Any, Dict[str, Any]]] = None,
+    ) -> List[Dict[str, Any]]:
+        """Segment one replica's flattened arrays into reference spans.
+
+        Tombstones carry no text, and the oracle's run coalescer merges
+        adjacent spans with deep-equal marks (peritext.ts:438-451), so the
+        span structure is fully determined by the *visible* elements' mask
+        rows: segment boundaries are where consecutive visible elements'
+        resolved bitsets differ (a numpy diff), never a per-character loop.
+        """
+        op_ids = list(table)
+
+        def decode_row(row: np.ndarray) -> frozenset:
+            return frozenset(
+                op_id
+                for m, op_id in enumerate(op_ids)
+                if row[m // 32] >> (m % 32) & 1
+            )
+
+        vis = np.flatnonzero(~deleted)
+        if vis.size == 0:
+            return []
+        v_has = has_np[vis]
+        v_mask = mask_np[vis]
+        v_chars = chars[vis]
+        change = np.empty(vis.size, bool)
+        change[0] = True
+        np.not_equal(v_has[1:], v_has[:-1], out=change[1:])
+        change[1:] |= (v_mask[1:] != v_mask[:-1]).any(axis=1)
+        starts = np.flatnonzero(change)
+        ends = np.append(starts[1:], vis.size)
+
+        if mark_cache is None:
+            mark_cache = {}
+        spans: List[Dict[str, Any]] = []
+        for s, e in zip(starts, ends):
+            if v_has[s]:
+                # Mask bits index this replica's own mark table, so a shared
+                # cache must key on the (deduped) table identity too.
+                key = (id(table), v_mask[s].tobytes())
+                marks = mark_cache.get(key)
+                if marks is None:
+                    marks = ops_to_marks(decode_row(v_mask[s]), table)
+                    mark_cache[key] = marks
+            else:
+                marks = {}
+            text = self._codepoints_to_str(v_chars[s:e])
+            if spans and spans[-1]["marks"] == marks:
+                spans[-1]["text"] += text  # the coalescing rule
+            else:
+                spans.append({"marks": dict(marks), "text": text})
+        return spans
 
     def spans(self, replica: str | int) -> List[Dict[str, Any]]:
         """Materialize one replica as formatted spans (the batch codepath).
@@ -469,42 +644,43 @@ class TpuUniverse:
         state = index_state(self.states, r)
         mask, has = K.flatten_sources_jit(state)
         n = int(state.length)
-        mask_np = np.asarray(mask[:n])
-        has_np = np.asarray(has[:n])
-        deleted = np.asarray(state.deleted[:n])
-        chars = np.asarray(state.chars[:n])
-        table = self._mark_op_table(state)
-        op_ids = list(table)
+        return self._spans_from_arrays(
+            np.asarray(mask[:n]),
+            np.asarray(has[:n]),
+            np.asarray(state.deleted[:n]),
+            np.asarray(state.chars[:n]),
+            self._mark_op_table(state),
+        )
 
-        def decode_row(row: np.ndarray) -> frozenset:
-            out = []
-            for m, op_id in enumerate(op_ids):
-                if row[m // 32] >> (m % 32) & 1:
-                    out.append(op_id)
-            return frozenset(out)
+    def spans_batch(self) -> List[List[Dict[str, Any]]]:
+        """All replicas' formatted spans from one batched device launch.
 
+        The flatten runs batched on device; host decode is numpy-segmented
+        per replica with the mark table and resolution cache shared across
+        the batch (converged replicas share every distinct bitset row).
+        """
+        mask, has = K.flatten_sources_batch(self.states)
+        mask_np = np.asarray(mask)
+        has_np = np.asarray(has)
+        deleted = np.asarray(self.states.deleted)
+        chars = np.asarray(self.states.chars)
+        lengths = np.asarray(self.states.length)
+        table = self._batch_mark_op_table()
         mark_cache: Dict[Any, Dict[str, Any]] = {}
-        spans: List[Dict[str, Any]] = []
-        characters: List[str] = []
-        marks: Dict[str, Any] = {}
-        prev_key: Any = None
-        for i in range(n):
-            key = (bool(has_np[i]), tuple(mask_np[i].tolist()))
-            if key != prev_key:
-                if key[0]:
-                    if key not in mark_cache:
-                        mark_cache[key] = ops_to_marks(decode_row(mask_np[i]), table)
-                    new_marks = mark_cache[key]
-                else:
-                    new_marks = {}
-                add_characters_to_spans(characters, marks, spans)
-                characters = []
-                marks = new_marks
-                prev_key = key
-            if not deleted[i]:
-                characters.append(chr(int(chars[i])))
-        add_characters_to_spans(characters, marks, spans)
-        return spans
+        out = []
+        for r in range(len(self.replica_ids)):
+            n = int(lengths[r])
+            out.append(
+                self._spans_from_arrays(
+                    mask_np[r, :n],
+                    has_np[r, :n],
+                    deleted[r, :n],
+                    chars[r, :n],
+                    table[r],
+                    mark_cache,
+                )
+            )
+        return out
 
     def text(self, replica: str | int) -> str:
         r = replica if isinstance(replica, int) else self.index_of[replica]
@@ -512,7 +688,7 @@ class TpuUniverse:
         n = int(state.length)
         chars = np.asarray(state.chars[:n])
         deleted = np.asarray(state.deleted[:n])
-        return "".join(chr(int(c)) for c, d in zip(chars, deleted) if not d)
+        return self._codepoints_to_str(chars[~deleted])
 
     def texts(self) -> List[str]:
         """All replicas' visible texts from one batched device readback."""
@@ -523,8 +699,7 @@ class TpuUniverse:
         for r in range(len(self.replica_ids)):
             n = int(lengths[r])
             row = chars[r, :n]
-            keep = ~deleted[r, :n]
-            out.append("".join(chr(int(c)) for c in row[keep]))
+            out.append(self._codepoints_to_str(row[~deleted[r, :n]]))
         return out
 
     def digests(self) -> np.ndarray:

@@ -107,3 +107,60 @@ def test_comment_requires_attrs():
     net = make_network()
     with pytest.raises(ValueError, match="require attrs"):
         net["alice"].apply_steps([("add_mark", 0, 3, "comment")])
+
+
+def test_remote_change_highlight_flow():
+    """The essay demo's flash flow (essay-demo.ts:47-75): remote patches
+    overlay temporary highlightChange marks on the view, local edits don't,
+    and flashes expire on tick.  Closes SURVEY §2.5's essay row."""
+    from peritext_tpu.bridge import EditorNetwork, RemoteChangeHighlighter
+
+    net = EditorNetwork(["alice", "bob"], initial_text="collaborative text")
+    alice = net["alice"]
+    bob = net["bob"]
+    flash = RemoteChangeHighlighter(alice, duration_ticks=1)
+
+    # Local edits never flash.
+    alice.insert(0, ">> ")
+    alice.sync()
+    assert all("highlightChange" not in s["marks"] for s in flash.spans())
+
+    # Remote typing + remote bold both flash on alice's view.
+    bob.insert(3, "NEW ")
+    bob.toggle_mark(3, 7, "strong")
+    bob.sync()
+    lit = [s for s in flash.spans() if "highlightChange" in s["marks"]]
+    assert lit and "".join(s["text"] for s in lit) == "NEW "
+    # The underlying document itself carries no highlight mark.
+    assert all("highlightChange" not in s["marks"] for s in alice.spans())
+    assert net.converged() or (bob.sync() or net.converged())
+
+    # Flash expires after its duration.
+    flash.tick()
+    assert flash.spans() == alice.spans()
+
+
+def test_remote_highlight_ranges_remap_through_later_patches():
+    """Flash ranges must track their characters through later inserts in the
+    same sync and through local edits (the PM decoration-mapping analog)."""
+    from peritext_tpu.bridge import EditorNetwork, RemoteChangeHighlighter
+
+    net = EditorNetwork(["alice", "bob"], initial_text="0123456789")
+    alice = net["alice"]
+    flash = RemoteChangeHighlighter(alice, duration_ticks=5)
+
+    # One remote sync delivering two changes: 'AB' at 5, then 'X' at 0.
+    net["bob"].insert(5, "AB")
+    net["bob"].insert(0, "X")
+    net["bob"].sync()
+    lit = "".join(
+        s["text"] for s in flash.spans() if "highlightChange" in s["marks"]
+    )
+    assert sorted(lit) == ["A", "B", "X"], lit
+
+    # A local edit before the flashes shifts them too.
+    alice.insert(0, "local ")
+    lit2 = "".join(
+        s["text"] for s in flash.spans() if "highlightChange" in s["marks"]
+    )
+    assert sorted(lit2) == ["A", "B", "X"], lit2

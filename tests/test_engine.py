@@ -403,3 +403,31 @@ def test_second_list_ops_raise_instead_of_corrupting():
     # And the failed ingestion must not have committed anything.
     assert uni.text("doc1") == before
     assert uni.clock("doc1") == clock_before
+
+
+def test_spans_batch_matches_per_replica_spans():
+    """spans_batch (one batched launch + shared decode caches) must equal
+    per-replica spans() exactly, including replicas with divergent states."""
+    docs, _, initial_change = generate_docs("batched spans")
+    doc1, doc2 = docs
+    uni = TpuUniverse(["a", "b", "c"])
+    uni.apply_changes({"a": [initial_change], "b": [initial_change], "c": [initial_change]})
+    c1, _ = doc1.change(
+        [
+            {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 7, "markType": "strong"},
+            {"path": ["text"], "action": "insert", "index": 3, "values": list("XY")},
+        ]
+    )
+    c2, _ = doc2.change(
+        [
+            {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 9, "markType": "link", "attrs": {"url": "https://s.test"}},
+            {"path": ["text"], "action": "delete", "index": 0, "count": 2},
+        ]
+    )
+    # a and b converge; c sees only one stream (divergent state in batch).
+    uni.apply_changes({"a": [c1, c2], "b": [c2, c1], "c": [c1]})
+    batch = uni.spans_batch()
+    for r, name in enumerate(["a", "b", "c"]):
+        assert batch[r] == uni.spans(name), name
+    assert batch[0] == batch[1]
+    assert batch[2] != batch[0]

@@ -66,3 +66,160 @@ def test_seq_only_sharding_flatten(batch):
     mask, has = jax.vmap(K.flatten_sources)(batch["states"])
     assert (np.asarray(mask_s) == np.asarray(mask)).all()
     assert (np.asarray(has_s) == np.asarray(has)).all()
+
+
+def test_sharded_sorted_merge_matches_single_device(batch):
+    """The production sorted-placement path under mesh shardings."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+
+    sp = prepare_sorted_batch([batch["text_ops"][r] for r in range(16)])
+    text = jnp.asarray(sp["text"])
+    rounds = jnp.asarray(sp["rounds"])
+    bufs = jnp.asarray(sp["bufs"])
+    mark_ops = jnp.asarray(batch["mark_ops"])
+    ranks = jnp.asarray(batch["ranks"])
+
+    ref = K.merge_step_sorted_batch(
+        batch["states"], text, rounds, sp["num_rounds"], mark_ops, ranks, bufs, sp["maxk"]
+    )
+    mesh = make_mesh(jax.devices()[:8], 4, 2)
+    states = shard_states(batch["states"], mesh)
+    out = K.merge_step_sorted_batch(
+        states, text, rounds, sp["num_rounds"], mark_ops, ranks, bufs, sp["maxk"]
+    )
+    for field in dataclasses.fields(ref):
+        a = np.asarray(getattr(ref, field.name))
+        b = np.asarray(getattr(out, field.name))
+        assert (a == b).all(), f"sorted sharded: field {field.name} diverged"
+
+
+def _adversarial_states(capacity):
+    """8 replicas: empty, exactly-full, and marks straddling shard edges."""
+    from peritext_tpu.ids import ActorRegistry
+    from peritext_tpu.ops.encode import AttrRegistry, encode_changes
+    from peritext_tpu.ops.state import make_empty_state, stack_states
+    from peritext_tpu.oracle import Doc
+
+    actors, attrs = ActorRegistry(), AttrRegistry()
+    doc = Doc("edge")
+    full_text = "".join(chr(ord("a") + i % 26) for i in range(capacity))
+    genesis, _ = doc.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list(full_text)},
+        ]
+    )
+    # Marks crossing every shard boundary of an 8-way seq split.
+    shard = capacity // 8
+    mark_change, _ = doc.change(
+        [
+            {"path": ["text"], "action": "addMark", "startIndex": shard - 1,
+             "endIndex": capacity - 1, "markType": "strong"},
+            {"path": ["text"], "action": "addMark", "startIndex": 2 * shard - 2,
+             "endIndex": 3 * shard + 2, "markType": "link", "attrs": {"url": "http://e.co"}},
+            {"path": ["text"], "action": "delete", "index": 4 * shard, "count": shard},
+        ]
+    )
+    rows_g, _, _ = encode_changes([genesis], actors, attrs)
+    rows_m, _, _ = encode_changes(
+        [mark_change], actors, attrs, text_obj=genesis["ops"][0]["opId"]
+    )
+    ranks = np.zeros(16, np.int32)
+    rk = actors.ranks()
+    ranks[: len(rk)] = rk
+    full = K.apply_ops_jit(
+        make_empty_state(capacity, 64), jnp.asarray(rows_g), jnp.asarray(ranks)
+    )
+    marked = K.apply_ops_jit(full, jnp.asarray(rows_m), jnp.asarray(ranks))
+    empty = make_empty_state(capacity, 64)
+    states = stack_states([empty, full, marked, empty, marked, full, marked, empty])
+    return states, jnp.asarray(ranks)
+
+
+@pytest.mark.parametrize("capacity", [64, 256])
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (4, 2), (8, 1)])
+def test_sharded_flatten_adversarial_lengths(capacity, mesh_shape):
+    """length == 0, length == capacity, tombstones and marks straddling
+    every shard edge: sharded materialization must stay bit-identical."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from peritext_tpu.parallel.mesh import state_sharding
+
+    states, ranks = _adversarial_states(capacity)
+    ref_mask, ref_has = jax.vmap(K.flatten_sources)(states)
+
+    mesh = make_mesh(jax.devices()[:8], *mesh_shape)
+    sharded = shard_states(states, mesh)
+    mask, has = jax.jit(
+        jax.vmap(K.flatten_sources), in_shardings=(state_sharding(mesh, True),)
+    )(sharded)
+    assert (np.asarray(mask) == np.asarray(ref_mask)).all()
+    assert (np.asarray(has) == np.asarray(ref_has)).all()
+
+    from peritext_tpu.schema import allow_multiple_array
+
+    multi = jnp.asarray(allow_multiple_array())
+    ref_digest = jax.vmap(K.convergence_digest, in_axes=(0, None, None))(
+        states, ranks, multi
+    )
+    dig = jax.vmap(K.convergence_digest, in_axes=(0, None, None))(sharded, ranks, multi)
+    assert (np.asarray(dig) == np.asarray(ref_digest)).all()
+
+
+@pytest.mark.parametrize("seq", [2, 8])
+def test_sharded_shard_map_flatten_adversarial(seq):
+    """The explicit shard_map flatten on the same adversarial fleet."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from peritext_tpu.parallel.shard import flatten_sources_sp
+
+    states, _ = _adversarial_states(128)
+    ref_mask, ref_has = jax.vmap(K.flatten_sources)(states)
+    mesh = make_mesh(jax.devices()[:8], 8 // seq, seq)
+    sharded = shard_states(states, mesh)
+    sp = flatten_sources_sp(mesh)
+    mask, has = sp(sharded.deleted, sharded.bnd_def, sharded.bnd_mask, sharded.length)
+    assert (np.asarray(mask) == np.asarray(ref_mask)).all()
+    assert (np.asarray(has) == np.asarray(ref_has)).all()
+
+
+def test_sharded_patch_path_matches_single_device(batch):
+    """The patch-emitting path (incremental codepath) under mesh shardings:
+    state and every per-op patch record must match unsharded exactly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from peritext_tpu.parallel.mesh import state_sharding
+    from peritext_tpu.schema import allow_multiple_array
+
+    rows = np.concatenate([batch["text_ops"], batch["mark_ops"]], axis=1)
+    ops = jnp.asarray(rows)
+    ranks = jnp.asarray(batch["ranks"])
+    multi = jnp.asarray(allow_multiple_array())
+
+    ref_state, ref_records = K.apply_ops_patched_batch(
+        batch["states"], ops, ranks, multi
+    )
+
+    mesh = make_mesh(jax.devices()[:8], 8, 1)
+    sharded = shard_states(batch["states"], mesh, shard_seq=False)
+    rep = NamedSharding(mesh, P())
+    fn = jax.jit(
+        jax.vmap(K.apply_ops_patched, in_axes=(0, 0, None, None)),
+        in_shardings=(
+            state_sharding(mesh, False),
+            NamedSharding(mesh, P("replica", None, None)),
+            rep,
+            rep,
+        ),
+    )
+    out_state, records = fn(sharded, ops, ranks, multi)
+    for field in dataclasses.fields(ref_state):
+        a = np.asarray(getattr(ref_state, field.name))
+        b = np.asarray(getattr(out_state, field.name))
+        assert (a == b).all(), f"patched sharded: field {field.name} diverged"
+    for key in ref_records:
+        assert (np.asarray(records[key]) == np.asarray(ref_records[key])).all(), key

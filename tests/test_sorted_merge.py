@@ -1,0 +1,161 @@
+"""Sort-based batch integration must equal the sequential scan path exactly.
+
+The placement proof (kernels.py) says simultaneous placement keyed by
+(skip-run stop, descending op id) equals sequential RGA application; these
+tests check it bit-for-bit against merge_step on randomized concurrent
+workloads, deep reference chains, and adversarial same-position inserts.
+"""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
+from peritext_tpu.ids import ActorRegistry
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.encode import (
+    AttrRegistry,
+    compute_rounds,
+    encode_changes,
+    fuse_insert_runs,
+    pad_buffer,
+    pad_rows,
+    split_rows,
+)
+from peritext_tpu.ops.state import make_empty_state, stack_states
+from peritext_tpu.oracle import Doc
+
+
+def sorted_inputs(text_rows_list, max_run=0):
+    """Fuse + label rounds + pad via the shared production helper."""
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+
+    sp = prepare_sorted_batch(text_rows_list, max_run=max_run)
+    return (
+        jnp.asarray(sp["text"]),
+        jnp.asarray(sp["rounds"]),
+        sp["num_rounds"],
+        jnp.asarray(sp["bufs"]),
+        sp["maxk"],
+    )
+
+
+def assert_states_equal(a, b, context=""):
+    for field in dataclasses.fields(a):
+        x = np.asarray(getattr(a, field.name))
+        y = np.asarray(getattr(b, field.name))
+        assert (x == y).all(), f"{context}: field {field.name} diverged"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("with_marks", [True, False])
+def test_sorted_merge_matches_scan_on_random_workloads(seed, with_marks):
+    workload = make_merge_workload(
+        doc_len=120, ops_per_merge=48, num_streams=4, with_marks=with_marks, seed=seed
+    )
+    batch = build_device_batch(workload, num_replicas=4, capacity=512, max_mark_ops=64)
+    text_rows = [np.asarray(batch["text_ops"][r]) for r in range(4)]
+    mark_ops = jnp.asarray(batch["mark_ops"])
+    ranks = jnp.asarray(batch["ranks"])
+
+    ref = K.merge_step_batch(
+        batch["states"], jnp.asarray(batch["text_ops"]), mark_ops, ranks
+    )
+    text, ro, nr, buf, maxk = sorted_inputs(text_rows)
+    out = K.merge_step_sorted_batch(
+        batch["states"], text, ro, nr, mark_ops, ranks, buf, maxk
+    )
+    assert_states_equal(ref, out, f"seed={seed}")
+
+
+def test_sorted_merge_deep_chains_and_same_position_races():
+    """Adversarial: multiple actors inserting at the same position, chains
+    of inserts referencing earlier batch elements, deletes of batch chars."""
+    base = Doc("base")
+    genesis, _ = base.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("wxyz")},
+        ]
+    )
+    streams = []
+    for name in ("alice", "bob", "carol"):
+        w = Doc(name)
+        w.apply_change(genesis)
+        c1, _ = w.change(
+            [{"path": ["text"], "action": "insert", "index": 2, "values": list(name[:2])}]
+        )
+        # Chain: type again right after the previous burst, then delete one
+        # of this batch's own characters.
+        c2, _ = w.change(
+            [
+                {"path": ["text"], "action": "insert", "index": 3, "values": list(name[2:].upper() or "Q")},
+                {"path": ["text"], "action": "delete", "index": 2, "count": 1},
+            ]
+        )
+        streams.append([c1, c2])
+
+    actors = ActorRegistry()
+    attrs = AttrRegistry()
+    genesis_rows, _, _ = encode_changes([genesis], actors, attrs)
+    text_obj = genesis["ops"][0]["opId"]
+    merged_rows, _, _ = encode_changes(
+        [c for s in streams for c in s], actors, attrs, text_obj=text_obj
+    )
+    ranks_np = np.zeros(64, np.int32)
+    rk = actors.ranks()
+    ranks_np[: len(rk)] = rk
+    ranks = jnp.asarray(ranks_np)
+
+    base_state = K.apply_ops_jit(
+        make_empty_state(128, 64), jnp.asarray(genesis_rows), ranks
+    )
+    states = stack_states([base_state])
+    text_rows, mark_rows = split_rows(merged_rows)
+    assert mark_rows.shape[0] == 0
+
+    ref = K.merge_step_batch(
+        states,
+        jnp.asarray(text_rows[None, ...]),
+        jnp.zeros((1, 1, K.OP_FIELDS), jnp.int32),
+        ranks,
+    )
+    text, ro, nr, buf, maxk = sorted_inputs([text_rows])
+    assert nr >= 2  # the chains force multiple rounds
+    out = K.merge_step_sorted_batch(
+        states, text, ro, nr, jnp.zeros((1, 1, K.OP_FIELDS), jnp.int32), ranks, buf, maxk
+    )
+    assert_states_equal(ref, out, "deep chains")
+
+
+def test_sorted_merge_unbounded_run_is_single_round():
+    """A pasted 300-char document fuses to one run row placed in one round."""
+    doc = Doc("paster")
+    doc.change([{"path": [], "action": "makeList", "key": "text"}])
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": list("ab" * 150)}]
+    )
+    actors, attrs = ActorRegistry(), AttrRegistry()
+    rows, _, _ = encode_changes(
+        [change], actors, attrs, text_obj=change["ops"][0].get("obj")
+    )
+    fused, _ = fuse_insert_runs(rows, max_run=0)
+    assert fused.shape[0] == 1
+    ro, nr = compute_rounds(fused)
+    assert nr == 1
+
+    ranks = jnp.asarray(np.zeros(8, np.int32))
+    states = stack_states([make_empty_state(512, 32)])
+    ref = K.merge_step_batch(
+        states,
+        jnp.asarray(rows[None, ...]),
+        jnp.zeros((1, 1, K.OP_FIELDS), jnp.int32),
+        ranks,
+    )
+    text, ro2, nr2, buf, maxk = sorted_inputs([rows])
+    assert maxk >= 300  # one 300-char block (bucketed)
+    out = K.merge_step_sorted_batch(
+        states, text, ro2, nr2, jnp.zeros((1, 1, K.OP_FIELDS), jnp.int32), ranks, buf, maxk
+    )
+    assert_states_equal(ref, out, "unbounded run")
