@@ -1,0 +1,266 @@
+"""The five BASELINE.json benchmark configs as one runnable module.
+
+Each config returns a JSON-able record; ``python -m peritext_tpu.bench.configs
+--config N`` prints it.  Configs 1-2 exercise the reference-model path (the
+bridge/oracle at human scale); configs 3-5 are the batched device workloads
+(BASELINE.md):
+
+1. 2-replica ``traces/links-minimal.json`` replay via the document API.
+2. fuzz-shaped random edit trace, 2 replicas x 1k ops, plain text.
+3. 1k-replica batched merge, 1k-char docs, insert/delete only.
+4. 10k-replica batched merge with overlapping marks.
+5. 100k-replica 10k-char docs, mixed marks, multi-chip mesh.  The full
+   shape needs a v5e-8's HBM; ``scale="small"`` (default off-hardware) runs
+   the same *shape* scaled down on whatever mesh exists so the codepath is
+   exercised end-to-end, and reports the scale it actually ran.
+
+Env knobs: CONFIG5_REPLICAS / CONFIG5_DOC_LEN override config 5's scale.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from typing import Any, Dict
+
+REFERENCE_TRACES = "/root/reference/traces"
+
+
+def config1_trace_replay() -> Dict[str, Any]:
+    """Replay the reference's links-minimal failure trace through both
+    engines (the reference-model workload: 2 replicas over the wire)."""
+    path = os.path.join(REFERENCE_TRACES, "links-minimal.json")
+    with open(path) as f:
+        trace = json.load(f)
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.runtime.sync import apply_changes
+
+    queues = trace["queues"]
+    start = time.perf_counter()
+    docs = {actor: Doc(actor) for actor in queues}
+    total = 0
+    for actor, doc in docs.items():
+        for other, changes in queues.items():
+            applied = apply_changes(doc, [dict(c) for c in changes])
+            total += len(applied)
+    elapsed = time.perf_counter() - start
+    spans = [d.get_text_with_formatting(["text"]) for d in docs.values()]
+    assert all(s == spans[0] for s in spans[1:]), "trace replay diverged"
+    return {
+        "config": 1,
+        "workload": "links-minimal trace replay (oracle, 2 replicas)",
+        "changes_applied": total,
+        "seconds": round(elapsed, 4),
+        "changes_per_sec": round(total / elapsed, 1),
+    }
+
+
+def config2_fuzz_style(ops: int = 1000, seed: int = 11) -> Dict[str, Any]:
+    """Random plain-text edit trace, 2 replicas, sync at the end."""
+    from peritext_tpu.fuzz import _random_delete, _random_insert
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.testing import generate_docs
+
+    rng = random.Random(seed)
+    docs, _, genesis = generate_docs("fuzz config", count=2)
+    changes = {d.actor_id: [] for d in docs}
+    budget = ops
+    while budget > 0:
+        doc = rng.choice(docs)
+        op = (_random_insert(rng, doc, 3) if rng.random() < 0.6 else _random_delete(rng, doc))
+        if op is None:
+            continue
+        change, _ = doc.change([op])
+        changes[doc.actor_id].append(change)
+        budget -= len(change["ops"])
+
+    uni = TpuUniverse(["a", "b"], capacity=1024)
+    start = time.perf_counter()
+    uni.apply_changes({"a": [genesis], "b": [genesis]})
+    stream = changes["doc1"] + changes["doc2"]
+    uni.apply_changes({"a": stream, "b": list(reversed_pairs(stream))})
+    digests = uni.digests()
+    elapsed = time.perf_counter() - start
+    assert digests[0] == digests[1], "config2 diverged"
+    n_ops = sum(len(c["ops"]) for c in stream)
+    return {
+        "config": 2,
+        "workload": "fuzz-style random edits, 2 replicas, ~1k internal ops",
+        "internal_ops": 2 * n_ops,
+        "seconds": round(elapsed, 4),
+        "ops_per_sec": round(2 * n_ops / elapsed, 1),
+    }
+
+
+def reversed_pairs(stream):
+    """Deliver causally-valid per-actor order but interleave actors
+    differently on the second replica (order-independence check)."""
+    a = [c for c in stream if c["actor"] == "doc1"]
+    b = [c for c in stream if c["actor"] == "doc2"]
+    out = []
+    for i in range(max(len(a), len(b))):
+        if i < len(b):
+            out.append(b[i])
+        if i < len(a):
+            out.append(a[i])
+    return out
+
+
+def config3_batched_plain(replicas: int = 1024) -> Dict[str, Any]:
+    from peritext_tpu.bench.workloads import time_batched_merge
+
+    r = time_batched_merge(num_replicas=replicas, doc_len=1000, ops_per_merge=64,
+                           with_marks=False, rounds=8)
+    return {
+        "config": 3,
+        "workload": f"{replicas}-replica batched merge, 1k-char docs, insert/delete",
+        "ops_per_sec": round(r["ops_per_sec"], 1),
+        "seconds": round(r["seconds"], 4),
+        "total_ops": r["total_ops"],
+    }
+
+
+def config4_batched_marked(replicas: int = 10240) -> Dict[str, Any]:
+    from peritext_tpu.bench.workloads import time_batched_merge
+
+    r = time_batched_merge(num_replicas=replicas, doc_len=1000, ops_per_merge=64,
+                           with_marks=True, rounds=4)
+    return {
+        "config": 4,
+        "workload": f"{replicas}-replica batched merge with overlapping marks",
+        "ops_per_sec": round(r["ops_per_sec"], 1),
+        "seconds": round(r["seconds"], 4),
+        "total_ops": r["total_ops"],
+    }
+
+
+def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -> Dict[str, Any]:
+    """Config-5 *shape*: long docs + marks, replica batch sharded over the
+    mesh, merge + convergence reduce + sequence-parallel flatten.
+
+    The headline shape (100k x 10k chars) needs a v5e-8; scale defaults fit
+    the machine at hand (env CONFIG5_REPLICAS / CONFIG5_DOC_LEN override —
+    the driver's v5e-8 run uses 100000 / 10000).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
+    from peritext_tpu.ops import kernels as K
+    from peritext_tpu.ops.encode import prepare_sorted_batch, split_rows
+    from peritext_tpu.parallel import make_mesh, shard_states
+    from peritext_tpu.parallel.shard import flatten_sources_sp
+    from peritext_tpu.schema import allow_multiple_array
+
+    n_dev = len(jax.devices())
+    replicas = replicas or int(os.environ.get("CONFIG5_REPLICAS", 8 * n_dev))
+    doc_len = doc_len or int(os.environ.get("CONFIG5_DOC_LEN", "4096"))
+    capacity = 1
+    while capacity < doc_len + 512:
+        capacity *= 2
+
+    n_streams = 4
+    workload = make_merge_workload(doc_len=doc_len, ops_per_merge=64,
+                                   num_streams=n_streams, with_marks=True, seed=5)
+    batch = build_device_batch(workload, replicas, capacity, 128)
+    seq = 2 if n_dev % 2 == 0 and n_dev >= 4 else 1
+    mesh = make_mesh(jax.devices()[: (n_dev // seq) * seq], n_dev // seq, seq)
+    base_states = shard_states(batch["states"], mesh)
+
+    # Host prep runs once per distinct stream; one gather tiles it to R
+    # (the same trick as TpuUniverse._prepare — never per-replica Python).
+    tile = np.arange(replicas) % n_streams
+    sp = prepare_sorted_batch([batch["text_ops"][r] for r in range(n_streams)])
+    text_np = sp["text"][tile]
+    rounds_np = sp["rounds"][tile]
+    bufs_np = sp["bufs"][tile]
+    ranks = jnp.asarray(batch["ranks"])
+    multi = jnp.asarray(allow_multiple_array())
+
+    def merge_and_digest(states, shift):
+        # Distinct op ids per invocation (counters shifted; refs into the
+        # genesis doc untouched) so no layer can serve cached results.
+        genesis_max = workload["genesis"]["startOp"] + len(workload["genesis"]["ops"]) - 1
+        text = np.array(text_np)
+        marks = np.array(batch["mark_ops"])
+        for arr in (text, marks):
+            arr[..., K.K_CTR] += (arr[..., K.K_CTR] > 0) * shift
+            for field in (K.K_REF_CTR, K.K_SCTR, K.K_ECTR):
+                arr[..., field] += (arr[..., field] > genesis_max) * shift
+        out = K.merge_step_sorted_batch(
+            states,
+            jnp.asarray(text),
+            jnp.asarray(rounds_np),
+            sp["num_rounds"],
+            jnp.asarray(marks),
+            ranks,
+            jnp.asarray(bufs_np),
+            sp["maxk"],
+        )
+        return out, np.asarray(K.convergence_digest_batch(out, ranks, multi))
+
+    flatten = flatten_sources_sp(mesh)
+
+    def flatten_once(states):
+        mask, has = flatten(states.deleted, states.bnd_def, states.bnd_mask, states.length)
+        np.asarray(has)  # host readback barrier
+
+    # Warm both programs (compile) untimed, then measure fresh-id runs.
+    warm_states, _ = merge_and_digest(base_states, 0)
+    flatten_once(warm_states)
+
+    start = time.perf_counter()
+    states, digests = merge_and_digest(base_states, 1_000_000)
+    merge_s = time.perf_counter() - start
+    for r in range(n_streams, replicas):
+        assert digests[r] == digests[r % n_streams], "config5 diverged across shards"
+
+    start = time.perf_counter()
+    flatten_once(states)
+    flatten_s = time.perf_counter() - start
+
+    total_ops = batch["total_ops"]
+    return {
+        "config": 5,
+        "workload": f"{replicas} replicas x {doc_len}-char docs, mixed marks, "
+        f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}",
+        "merge_ops_per_sec": round(total_ops / merge_s, 1),
+        "merge_seconds": round(merge_s, 4),
+        "flatten_chars_per_sec": round(replicas * doc_len / flatten_s, 1),
+        "platform": jax.devices()[0].platform,
+        "note": "headline shape is 100000 x 10000 on v5e-8; this run is the "
+        "same shape at the scale this host fits"
+        if replicas < 100_000
+        else "headline shape",
+    }
+
+
+CONFIGS = {
+    1: config1_trace_replay,
+    2: config2_fuzz_style,
+    3: config3_batched_plain,
+    4: config4_batched_marked,
+    5: config5_multichip,
+}
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", type=int, choices=sorted(CONFIGS), required=True)
+    parser.add_argument("--platform", default=None,
+                        help="pin jax_platforms before first backend use")
+    args = parser.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    print(json.dumps(CONFIGS[args.config]()))
+
+
+if __name__ == "__main__":
+    main()

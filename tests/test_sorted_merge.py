@@ -159,3 +159,35 @@ def test_sorted_merge_unbounded_run_is_single_round():
         states, text, ro2, nr2, jnp.zeros((1, 1, K.OP_FIELDS), jnp.int32), ranks, buf, maxk
     )
     assert_states_equal(ref, out, "unbounded run")
+
+
+def test_universe_falls_back_to_scan_on_deep_histories():
+    """A deep single-writer history (end-appends chained through elements
+    created by earlier changes, interleaved so run fusion can't flatten the
+    chain) exceeds the sorted path's round budget; the universe must fall
+    back to the scan path — observable via stats — and match the oracle."""
+    import os
+
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.testing import generate_docs
+
+    if os.environ.get("PERITEXT_MERGE_PATH") == "scan":
+        pytest.skip("scan path forced; fallback branch not reachable")
+
+    docs, _, genesis = generate_docs("deep")
+    writer = docs[0]
+    changes = [genesis]
+    for i in range(40):
+        if i % 2 == 0:
+            idx = len(writer.root["text"])  # chain: references previous append
+        else:
+            idx = 0  # breaks row adjacency so fusion can't flatten the chain
+        change, _ = writer.change(
+            [{"path": ["text"], "action": "insert", "index": idx, "values": [chr(97 + i % 26)]}]
+        )
+        changes.append(change)
+
+    uni = TpuUniverse(["r"], capacity=256)
+    uni.apply_changes({"r": changes})
+    assert uni.stats["scan_fallbacks"] == 1, "fallback branch did not trigger"
+    assert uni.spans("r") == writer.get_text_with_formatting(["text"])
