@@ -335,19 +335,9 @@ def compute_rounds(rows: np.ndarray) -> Tuple[np.ndarray, int]:
     return round_of, int(round_of.max()) + 1
 
 
-def prepare_sorted_batch(
-    text_rows_list: Sequence[np.ndarray], max_run: int = 0
-) -> Dict[str, Any]:
-    """Shared preparation for the sort-based placement path.
-
-    Fuses insert runs (unbounded by default — placement scatters need no
-    static window), labels reference-depth rounds, and pads/stacks the
-    per-stream row arrays.  Returns a dict with ``text`` [G, L, F],
-    ``rounds`` [G, L], ``bufs`` [G, B], ``num_rounds``, and ``maxk``
-    (bucketed run-length cap for the kernel's static block width).  Used by
-    the universe ingest path, the benchmark, and the differential tests so
-    the three can never diverge.
-    """
+def _fuse_and_rounds(
+    text_rows_list: Sequence[np.ndarray], max_run: int
+) -> Tuple[list, list, list, int, int]:
     fused, bufs, round_labels = [], [], []
     num_rounds, maxk = 1, 1
     for rows in text_rows_list:
@@ -360,6 +350,39 @@ def prepare_sorted_batch(
         fused.append(fr)
         bufs.append(fb)
         round_labels.append(ro)
+    return fused, bufs, round_labels, num_rounds, maxk
+
+
+def prepare_sorted_batch(
+    text_rows_list: Sequence[np.ndarray],
+    max_run: int = 0,
+    fallback_max_rounds: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Shared preparation for the sort-based placement path.
+
+    Fuses insert runs (unbounded by default — placement scatters need no
+    static window), labels reference-depth rounds, and pads/stacks the
+    per-stream row arrays.  Returns a dict with ``text`` [G, L, F],
+    ``rounds`` [G, L], ``bufs`` [G, B], ``num_rounds``, ``maxk`` (bucketed
+    run-length cap for the kernel's static block width), and ``fell_back``.
+    Used by the universe ingest path, the benchmark, and the differential
+    tests so the three can never diverge.
+
+    With ``fallback_max_rounds``, batches whose reference depth exceeds it
+    (deep single-writer histories, where placement rounds degenerate) are
+    re-fused with the scan path's MAX_RUN_LEN window instead, before any
+    padding/stacking happens, and flagged ``fell_back=True`` so the caller
+    can launch the sequential scan kernel.
+    """
+    fused, bufs, round_labels, num_rounds, maxk = _fuse_and_rounds(
+        text_rows_list, max_run
+    )
+    fell_back = False
+    if fallback_max_rounds is not None and num_rounds > fallback_max_rounds:
+        fell_back = True
+        fused, bufs, round_labels, num_rounds, maxk = _fuse_and_rounds(
+            text_rows_list, K.MAX_RUN_LEN
+        )
     text_pad = bucket_length(max(max(f.shape[0] for f in fused), 1))
     buf_pad = bucket_length(max(max(b.shape[0] for b in bufs), K.MAX_RUN_LEN))
     return {
@@ -370,6 +393,7 @@ def prepare_sorted_batch(
         "bufs": np.stack([pad_buffer(b, buf_pad) for b in bufs]),
         "num_rounds": num_rounds,
         "maxk": bucket_length(maxk, minimum=1),
+        "fell_back": fell_back,
     }
 
 

@@ -797,6 +797,24 @@ def place_text_batch(
 # chain's.  log2(2M) gather rounds replace the M sequential scan steps.
 
 
+def _or_accumulate(mask: jax.Array, bit_rows: jax.Array) -> jax.Array:
+    """OR of the selected one-hot bit rows: [N, M] bool x [M, W] uint32.
+
+    Every row of ``bit_rows`` carries a *distinct* bit, so a sum has no
+    carries and equals the OR — but a float32 matmul can only be trusted up
+    to the 24-bit mantissa.  Split each word into 16-bit halves first: every
+    column then sums distinct powers of two below 2^16, exact in float32,
+    and the accumulation runs as one MXU-shaped [N, M] x [M, 2W] matmul
+    instead of materializing an [N, M, W] integer intermediate.
+    """
+    sel = mask.astype(jnp.float32)
+    lo = (bit_rows & jnp.uint32(0xFFFF)).astype(jnp.float32)
+    hi = (bit_rows >> 16).astype(jnp.float32)
+    out = sel @ jnp.concatenate([lo, hi], axis=1)  # [N, 2W], exact
+    w = bit_rows.shape[1]
+    return out[:, :w].astype(jnp.uint32) | (out[:, w:].astype(jnp.uint32) << 16)
+
+
 def _apply_marks_batch(
     bnd_def, bnd_mask, mark_ops, elem_ctr, elem_act, length, mark_count, w_words
 ):
@@ -881,7 +899,7 @@ def _apply_marks_batch(
         # Bits ORed into q between prev and this op (in-range, defined).
         seg = in_range_t[qc] & (q >= 0)[:, None]
         seg = seg & (midx[None, :] > prev[:, None]) & (midx[None, :] < midx[:, None])
-        seg_bits = (seg.astype(jnp.uint32) @ B.astype(jnp.uint32)).astype(jnp.uint32)
+        seg_bits = _or_accumulate(seg, B)
         # Root base: q's pre-batch row when no batch op rebased it first.
         root_row = jnp.where(
             ((prev < 0) & (q >= 0))[:, None] & d0[qc][:, None],
@@ -920,7 +938,7 @@ def _apply_marks_batch(
     )
     start_time = jnp.where(written_any, w_last, -1)
     tail_mask = in_range_t & (midx[None, :] > start_time[:, None])  # [2C, M]
-    tail = (tail_mask.astype(jnp.uint32) @ B.astype(jnp.uint32)).astype(jnp.uint32)
+    tail = _or_accumulate(tail_mask, B)
     touched = written_any | (d0 & tail_mask.any(axis=1))
     new_mask = jnp.where(touched[:, None], base_rows | tail, bnd_mask)
     new_def = bnd_def | written_any
@@ -1027,12 +1045,49 @@ def _merge_step_sorted_batch(maxk: int):
 
 
 def merge_step_sorted_batch(
-    states, text_ops, round_of, num_rounds, mark_ops, ranks, char_buf, maxk: int
+    states,
+    text_ops,
+    round_of,
+    num_rounds,
+    mark_ops,
+    ranks,
+    char_buf,
+    maxk: int,
+    chunk: int | None = None,
 ):
-    """Jitted batched entry point; one cache entry per maxk bucket."""
-    return _merge_step_sorted_batch(maxk)(
-        states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks, char_buf
-    )
+    """Jitted batched entry point; one cache entry per maxk bucket.
+
+    ``chunk`` (or PERITEXT_SORTED_CHUNK) is an opt-in memory valve: the
+    placement/mark phases hold O(L*C + M*2C) transients *per replica*, so a
+    very large unsharded batch can exceed HBM; chunking launches the same
+    program over R-slices sequentially (at most two program shapes: the
+    even chunks and one remainder).  Off by default — mesh-sharded batches
+    already divide the transients across chips.
+    """
+    import os
+
+    r = text_ops.shape[0]
+    if chunk is None:
+        chunk = int(os.environ.get("PERITEXT_SORTED_CHUNK", "0"))
+    fn = _merge_step_sorted_batch(maxk)
+    nr = jnp.int32(num_rounds)
+    if not chunk or chunk >= r:
+        return fn(states, text_ops, round_of, nr, mark_ops, ranks, char_buf)
+    outs = []
+    for i in range(0, r, chunk):
+        sl = slice(i, min(i + chunk, r))
+        outs.append(
+            fn(
+                jax.tree.map(lambda x: x[sl], states),
+                text_ops[sl],
+                round_of[sl],
+                nr,
+                mark_ops[sl],
+                ranks,
+                char_buf[sl],
+            )
+        )
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs), *outs)
 
 
 def flatten_sources(state: DocState):

@@ -400,21 +400,23 @@ class TpuUniverse:
         if not any_rows:
             self._commit(prep)
             return
-        sorted_prep = prepare_sorted_batch(
-            text_rows_list, max_run=K.MAX_RUN_LEN if use_scan else 0
-        )
         # Cost model: a placement round does O(L) x the vector work of one
         # scan step, so sorted wins only when the batch's reference depth D
         # is far below its row count (concurrent merge batches: D is 1-3).
         # Deep single-writer histories (replaying one actor's whole log,
         # where most inserts reference same-batch elements) degenerate to
-        # D ~ L; fall back to the sequential scan there.
-        if not use_scan and sorted_prep["num_rounds"] > int(
-            os.environ.get("PERITEXT_SORTED_MAX_ROUNDS", "8")
-        ):
+        # D ~ L; prepare_sorted_batch re-fuses those for the sequential
+        # scan before any padding happens.
+        sorted_prep = prepare_sorted_batch(
+            text_rows_list,
+            max_run=K.MAX_RUN_LEN if use_scan else 0,
+            fallback_max_rounds=None
+            if use_scan
+            else int(os.environ.get("PERITEXT_SORTED_MAX_ROUNDS", "8")),
+        )
+        if sorted_prep["fell_back"]:
             use_scan = True
             self.stats["scan_fallbacks"] += 1
-            sorted_prep = prepare_sorted_batch(text_rows_list, max_run=K.MAX_RUN_LEN)
         mark_pad = bucket_length(max(max_mark, 1))
         g_mark = np.stack([pad_rows(rows, mark_pad) for rows in mark_rows_list])
         # One vectorized gather expands groups to the replica batch.

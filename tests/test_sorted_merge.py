@@ -191,3 +191,23 @@ def test_universe_falls_back_to_scan_on_deep_histories():
     uni.apply_changes({"r": changes})
     assert uni.stats["scan_fallbacks"] == 1, "fallback branch did not trigger"
     assert uni.spans("r") == writer.get_text_with_formatting(["text"])
+
+
+def test_chunked_sorted_merge_matches_unchunked():
+    """The R-chunking memory valve (uneven tail included) is bit-exact."""
+    workload = make_merge_workload(
+        doc_len=80, ops_per_merge=32, num_streams=3, with_marks=True, seed=9
+    )
+    batch = build_device_batch(workload, num_replicas=7, capacity=256, max_mark_ops=64)
+    text, ro, nr, buf, maxk = sorted_inputs(
+        [np.asarray(batch["text_ops"][r]) for r in range(7)]
+    )
+    mark_ops = jnp.asarray(batch["mark_ops"])
+    ranks = jnp.asarray(batch["ranks"])
+    ref = K.merge_step_sorted_batch(
+        batch["states"], text, ro, nr, mark_ops, ranks, buf, maxk
+    )
+    out = K.merge_step_sorted_batch(
+        batch["states"], text, ro, nr, mark_ops, ranks, buf, maxk, chunk=3
+    )
+    assert_states_equal(ref, out, "chunked")
