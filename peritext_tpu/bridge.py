@@ -221,6 +221,78 @@ class Editor:
         self.queue.flush()
 
 
+# -- editor document model (the reference's node schema + doc builder) -------
+#
+# Reference schema.ts:10-20 declares ``doc > paragraph+ > text*`` and
+# bridge.ts:394-414 (prosemirrorDocFromCRDT) builds the editor document from
+# the CRDT spans; bridge.ts:355-362 maps editor positions to content
+# positions.  The toolkit is abstracted, so the document is plain dicts with
+# the same node shapes.
+
+NODE_SCHEMA = {"doc": ("paragraph+",), "paragraph": ("text*",)}
+
+
+def editor_doc_from_spans(spans: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Build the editor document tree from formatted spans.
+
+    Paragraph breaks are newline characters in the text stream (the
+    reference renders one paragraph because its demo content has none);
+    each paragraph holds text nodes carrying their mark maps.  An empty
+    document is a single empty paragraph (the reference's empty-doc special
+    case, bridge.ts:402-407).
+    """
+    paragraphs: List[List[Dict[str, Any]]] = [[]]
+    for span in spans:
+        parts = span["text"].split("\n")
+        for i, part in enumerate(parts):
+            if i > 0:
+                paragraphs.append([])
+            if part:
+                paragraphs[-1].append(
+                    {"type": "text", "text": part, "marks": dict(span["marks"])}
+                )
+    return {
+        "type": "doc",
+        "content": [
+            {"type": "paragraph", "content": para} for para in paragraphs
+        ],
+    }
+
+
+def editor_doc_text(doc: Dict[str, Any]) -> str:
+    """Inverse view: the document's plain text with paragraph breaks."""
+    return "\n".join(
+        "".join(node["text"] for node in para["content"])
+        for para in doc["content"]
+    )
+
+
+def content_pos_from_editor_pos(pos: int, doc: Dict[str, Any]) -> int:
+    """Editor position -> CRDT content index.
+
+    The reference's contentPosFromProsemirrorPos (bridge.ts:355-362) is the
+    single-paragraph special case (pos - 1, clamped — its demo content has
+    no paragraph breaks).  The general mapping walks the node tree: each
+    paragraph costs one opening and one closing token in editor-position
+    space, while in content space paragraphs join with one newline
+    character.  Out-of-range positions clamp to the document ends.
+    """
+    paragraphs = doc["content"]
+    editor = 0  # editor position just before this paragraph's opening token
+    content = 0  # content index of this paragraph's first character
+    total = sum(
+        sum(len(n["text"]) for n in p["content"]) for p in paragraphs
+    ) + max(len(paragraphs) - 1, 0)
+    for para in paragraphs:
+        length = sum(len(n["text"]) for n in para["content"])
+        start = editor + 1  # inside the paragraph, after its opening token
+        if pos <= start + length:
+            return min(content + max(pos - start, 0), total)
+        editor += length + 2
+        content += length + 1  # the separating newline
+    return total
+
+
 class RemoteChangeHighlighter:
     """Flash remote edits with temporary highlight marks.
 

@@ -503,22 +503,62 @@ class TpuUniverse:
         pad = bucket_length(max_rows)
         g_ops = np.stack([pad_rows(g["rows"], pad) for g in groups])
         ops = g_ops[group_of]
-        ranks = self._ranks()
-        self.stats["launches"] += 1
+        ranks = jax.numpy.asarray(self._ranks())
+        multi = jax.numpy.asarray(allow_multiple_array())
         pad_per_group = (g_ops[:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1)
         self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
-        self.states, records = K.apply_ops_patched_batch(
-            self.states,
-            jax.numpy.asarray(ops),
-            jax.numpy.asarray(ranks),
-            jax.numpy.asarray(allow_multiple_array()),
-        )
+
+        # The per-op patch records materialize [R, ops, 2C] slot planes; at
+        # large R that dwarfs the state, so launch over R-chunks (opt-in,
+        # PERITEXT_PATCH_CHUNK) and read each chunk's records back to host
+        # before the next chunk's launch.  Device state is immutable, so a
+        # mid-chunk failure rolls back to the pre-batch pytree and nothing
+        # commits (same atomicity contract as the fast path).
+        import math as _math
+        import os as _os
+
+        n = len(self.replica_ids)
+        raw = _os.environ.get("PERITEXT_PATCH_CHUNK", "0")
+        try:
+            chunk = int(raw)
+        except ValueError:
+            raise ValueError(f"PERITEXT_PATCH_CHUNK must be an integer, got {raw!r}")
+        if chunk < 0:
+            raise ValueError(f"PERITEXT_PATCH_CHUNK must be >= 0, got {chunk}")
+        chunk = chunk or n
+        # Equalize chunk sizes where possible so the jit caches hold at most
+        # two program shapes (the even chunks and one smaller tail).
+        chunk = _math.ceil(n / _math.ceil(n / chunk))
+        prev_states = self.states
+        try:
+            state_slices = []
+            record_chunks: List[Dict[str, np.ndarray]] = []
+            for i in range(0, n, chunk):
+                sl = slice(i, min(i + chunk, n))
+                self.stats["launches"] += 1
+                st, records = K.apply_ops_patched_batch(
+                    jax.tree.map(lambda x: x[sl], self.states),
+                    jax.numpy.asarray(ops[sl]),
+                    ranks,
+                    multi,
+                )
+                state_slices.append(st)
+                record_chunks.append({k: np.asarray(v) for k, v in records.items()})
+            self.states = (
+                state_slices[0]
+                if len(state_slices) == 1
+                else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
+            )
+        except Exception:
+            self.states = prev_states
+            raise
         self._commit(prep)
-        records = {k: np.asarray(v) for k, v in records.items()}
+        tables = self._batch_mark_op_table()
         for r, name in enumerate(self.replica_ids):
-            state = index_state(self.states, r)
-            table = self._mark_op_table(state)
-            out[name].extend(assemble_patches(records, r, ops[r], table, self.attrs))
+            rec = record_chunks[r // chunk]
+            out[name].extend(
+                assemble_patches(rec, r % chunk, ops[r], tables[r], self.attrs)
+            )
         return out
 
     # -- materialization ----------------------------------------------------

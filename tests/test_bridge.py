@@ -164,3 +164,58 @@ def test_remote_highlight_ranges_remap_through_later_patches():
         s["text"] for s in flash.spans() if "highlightChange" in s["marks"]
     )
     assert sorted(lit2) == ["A", "B", "X"], lit2
+
+
+def test_editor_doc_from_spans_builds_node_tree():
+    """The doc > paragraph+ > text* builder (reference schema.ts:10-20 +
+    prosemirrorDocFromCRDT, bridge.ts:394-414)."""
+    from peritext_tpu.bridge import (
+        content_pos_from_editor_pos,
+        editor_doc_from_spans,
+        editor_doc_text,
+    )
+
+    spans = [
+        {"marks": {"strong": {"active": True}}, "text": "Title\nbo"},
+        {"marks": {}, "text": "dy text"},
+    ]
+    doc = editor_doc_from_spans(spans)
+    assert doc["type"] == "doc"
+    assert [p["type"] for p in doc["content"]] == ["paragraph", "paragraph"]
+    first, second = doc["content"]
+    assert first["content"] == [
+        {"type": "text", "text": "Title", "marks": {"strong": {"active": True}}}
+    ]
+    assert [n["text"] for n in second["content"]] == ["bo", "dy text"]
+    assert editor_doc_text(doc) == "Title\nbody text"
+
+    # Empty document: one empty paragraph (the reference special case).
+    empty = editor_doc_from_spans([])
+    assert empty == {"type": "doc", "content": [{"type": "paragraph", "content": []}]}
+
+    # Position mapping (bridge.ts:355-362 generalized to paragraphs):
+    # doc "Title\nbody text" -> para0 "Title" (editor 1..6), para1
+    # "body text" (editor 8..17); content indices include the newline.
+    assert content_pos_from_editor_pos(0, doc) == 0
+    assert content_pos_from_editor_pos(1, doc) == 0  # before 'T'
+    assert content_pos_from_editor_pos(6, doc) == 5  # end of "Title" (the \n)
+    assert content_pos_from_editor_pos(8, doc) == 6  # before 'b' (content 6)
+    assert content_pos_from_editor_pos(12, doc) == 10  # inside "body"
+    assert content_pos_from_editor_pos(99, doc) == 15  # clamp to doc end
+    single = editor_doc_from_spans([{"marks": {}, "text": "abcdef"}])
+    # Single paragraph degenerates to the reference's pos - 1 rule.
+    assert content_pos_from_editor_pos(5, single) == 4
+    assert content_pos_from_editor_pos(0, single) == 0
+    assert content_pos_from_editor_pos(99, single) == 6
+
+
+def test_editor_doc_round_trips_live_session():
+    """The builder over a real editing session's spans."""
+    from peritext_tpu.bridge import EditorNetwork, editor_doc_from_spans, editor_doc_text
+
+    net = EditorNetwork(["a", "b"], initial_text="one\ntwo")
+    net["a"].toggle_mark(0, 3, "strong")
+    net["a"].sync()
+    doc = editor_doc_from_spans(net["b"].spans())
+    assert editor_doc_text(doc) == "one\ntwo"
+    assert doc["content"][0]["content"][0]["marks"] == {"strong": {"active": True}}

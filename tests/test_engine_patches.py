@@ -133,3 +133,37 @@ def test_engine_patch_stream_random_differential(seed):
     engine_patches = uni.apply_changes_with_patches({"observer": stream})["observer"]
     assert engine_patches == oracle_patches, f"seed {seed}"
     assert accumulate_patches(engine_patches) == oracle.get_text_with_formatting(["text"])
+
+
+def test_patch_path_chunked_matches_unchunked(monkeypatch):
+    """PERITEXT_PATCH_CHUNK slices the record launches; patch streams and
+    states must be identical (uneven tail included)."""
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.testing import generate_docs
+
+    def run(chunk):
+        if chunk:
+            monkeypatch.setenv("PERITEXT_PATCH_CHUNK", str(chunk))
+        else:
+            monkeypatch.delenv("PERITEXT_PATCH_CHUNK", raising=False)
+        docs, _, genesis = generate_docs("chunked patches", count=3)
+        d1, d2, d3 = docs
+        c1, _ = d1.change(
+            [{"path": ["text"], "action": "insert", "index": 0, "values": list("xy")},
+             {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 6,
+              "markType": "strong"}]
+        )
+        c2, _ = d2.change(
+            [{"path": ["text"], "action": "delete", "index": 3, "count": 2}]
+        )
+        uni = TpuUniverse(["a", "b", "c", "d", "e"])
+        uni.apply_changes_with_patches({n: [genesis] for n in ["a", "b", "c", "d", "e"]})
+        patches = uni.apply_changes_with_patches(
+            {"a": [c1, c2], "b": [c2, c1], "c": [c1], "d": [c2], "e": []}
+        )
+        return patches, [uni.spans(n) for n in ["a", "b", "c", "d", "e"]]
+
+    ref_patches, ref_spans = run(0)
+    chk_patches, chk_spans = run(2)  # 5 replicas -> chunks of 2 + tail of 1
+    assert chk_patches == ref_patches
+    assert chk_spans == ref_spans
