@@ -245,10 +245,12 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
     carried here — the host appends them (they are tiny and independent of
     slot state); only mark_count is tracked for bit allocation.
 
-    NOTE: validated in interpret mode; the broadcast+reshape lane
-    expansions and the per-word-block reshape reduction have not yet been
-    compiled under Mosaic on hardware (the tunnel was down this round) —
-    re-verify lowering before enabling this path in the benchmark.
+    NOTE: validated in interpret mode only; never compiled under Mosaic on
+    hardware (the tunnel was down in rounds 1-2).  The lane-expansion and
+    per-word-block reductions were rewritten as static 2D select/max loops
+    (carry_row / expand_rows) to avoid 3D broadcast+reshape, but those loops
+    are equally unverified — compile + re-run the differential tests with
+    interpret=False before enabling this path in the benchmark.
 
     Per op (see kernels._apply_mark_fast for the write-class derivation):
     - defined slots inside [s, e): OR in the op bit (own-row carry);
@@ -306,6 +308,9 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         in_range2 = (slot2 >= s_slot) & (slot2 < e_slot) & s_lt_e & is_mark
 
         # Carry rows for s and e: masked max over lanes per word block.
+        # The per-block reduction loops over the (small, static) word count
+        # with 2D masked maxes instead of a 3D reshape, which Mosaic may
+        # not lower.
         def carry_row(target_slot):
             src = jnp.max(
                 jnp.where(defined & (slot2 <= target_slot), slot2, -1),
@@ -314,9 +319,15 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
             )  # [B, 1]
             sel = lane_slot == src  # [B, W*2C]; no lane selected when src=-1
             vals = jnp.where(sel, mkv, jnp.uint32(0))
-            # Reduce each word block's 2C lanes to one value (at most one
-            # lane per block is selected).
-            return vals.reshape(b, w, 2 * c).max(axis=2)  # [B, W]
+            cols = [
+                jnp.max(
+                    jnp.where(lane_word == j, vals, jnp.uint32(0)),
+                    axis=1,
+                    keepdims=True,
+                )
+                for j in range(w)
+            ]
+            return jnp.concatenate(cols, axis=1)  # [B, W]
 
         row_s = carry_row(s_slot)  # [B, W]
         bit_blocks = jnp.where(
@@ -332,17 +343,25 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         or_lanes = jnp.concatenate([or_slots] * w, axis=1) & (lane_word == word_of_m)
         new_mask = jnp.where(or_lanes, mkv | bit, mkv)
 
+        # Word-major lane expansion of [B, W] word values: lane l takes
+        # rows[:, l // 2C].  A static select per word block keeps every op
+        # 2D (no 3D broadcast+reshape, which Mosaic may not lower; note
+        # pltpu.repeat is *tile* semantics, the wrong layout here).
+        def expand_rows(rows):  # [B, W] -> [B, W*2C]
+            out = jnp.zeros_like(mkv)
+            for j in range(w):
+                out = jnp.where(lane_word == j, rows[:, j : j + 1], out)
+            return out
+
         # 2) slot s write: row_s word values at lanes lane_slot == s_slot.
         write_s = is_mark & s_lt_e
         s_lanes = (lane_slot == s_slot) & write_s
-        row_s_lanes = jnp.broadcast_to(row_s[:, :, None], (b, w, 2 * c)).reshape(b, w * 2 * c)
-        new_mask = jnp.where(s_lanes, row_s_lanes, new_mask)
+        new_mask = jnp.where(s_lanes, expand_rows(row_s), new_mask)
 
         # 3) slot e write (skipped for endOfText).
         write_e = is_mark & (e_slot < 2 * c)
         e_lanes = (lane_slot == e_slot) & write_e
-        row_e_lanes = jnp.broadcast_to(row_e[:, :, None], (b, w, 2 * c)).reshape(b, w * 2 * c)
-        new_mask = jnp.where(e_lanes, row_e_lanes, new_mask)
+        new_mask = jnp.where(e_lanes, expand_rows(row_e), new_mask)
 
         mask_out[:] = new_mask
         new_def = (
