@@ -30,6 +30,20 @@ def __getattr__(name):
         from peritext_tpu import ops
 
         return getattr(ops, name)
+    # Bridge surfaces load lazily for the same reason (Editor pulls in the
+    # runtime package).
+    if name in (
+        "Editor",
+        "EditorNetwork",
+        "RemoteChangeHighlighter",
+        "editor_doc_from_spans",
+        "editor_doc_text",
+        "content_pos_from_editor_pos",
+        "initialize_docs",
+    ):
+        from peritext_tpu import bridge
+
+        return getattr(bridge, name)
     raise AttributeError(name)
 
 __version__ = "0.1.0"
@@ -41,5 +55,14 @@ __all__ = [
     "register_mark_type",
     "MARK_SPEC",
     "MARK_TYPE_ID",
+    "TpuDoc",
+    "TpuUniverse",
+    "Editor",
+    "EditorNetwork",
+    "RemoteChangeHighlighter",
+    "editor_doc_from_spans",
+    "editor_doc_text",
+    "content_pos_from_editor_pos",
+    "initialize_docs",
     "__version__",
 ]

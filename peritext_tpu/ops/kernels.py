@@ -1153,6 +1153,9 @@ def resolve_cursor_index(state: DocState, ctr: jax.Array, act: jax.Array):
 
 cursor_elem_jit = jax.jit(cursor_elem)
 resolve_cursor_index_jit = jax.jit(resolve_cursor_index)
+# Fleet variants: one launch resolves a cursor per replica.
+cursor_elems_batch = jax.jit(jax.vmap(cursor_elem, in_axes=(0, 0)))
+resolve_cursor_indices_batch = jax.jit(jax.vmap(resolve_cursor_index, in_axes=(0, 0, 0)))
 
 
 def visible_elem_id(state: DocState, index: jax.Array, peek: jax.Array):

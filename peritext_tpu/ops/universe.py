@@ -792,6 +792,51 @@ class TpuUniverse:
             raise KeyError(f"List element not found: {cursor['elemId']}")
         return int(index)
 
+    def get_cursors(self, indices: Sequence[int]) -> List[Dict[str, Any]]:
+        """Stable cursors for one visible index per replica, in one launch
+        (the fleet form of get_cursor)."""
+        if len(indices) != len(self.replica_ids):
+            raise ValueError("need one index per replica")
+        ctrs, acts, founds = K.cursor_elems_batch(
+            self.states, jax.numpy.asarray(np.asarray(indices, np.int32))
+        )
+        founds = np.asarray(founds)
+        if not founds.all():
+            bad = int(np.flatnonzero(~founds)[0])
+            raise IndexError(f"List index out of bounds: {indices[bad]} (replica {bad})")
+        ctrs = np.asarray(ctrs)
+        acts = np.asarray(acts)
+        return [
+            {
+                "objectId": self.roots[r].get("__lists__", {}).get("text"),
+                "elemId": make_op_id(int(ctrs[r]), self.actors.actor(int(acts[r]))),
+            }
+            for r in range(len(self.replica_ids))
+        ]
+
+    def resolve_cursors(self, cursors: Sequence[Dict[str, Any]]) -> List[int]:
+        """Current visible indices of one cursor per replica, in one launch."""
+        from peritext_tpu.ids import parse_op_id
+
+        if len(cursors) != len(self.replica_ids):
+            raise ValueError("need one cursor per replica")
+        ctrs = np.zeros(len(cursors), np.int32)
+        acts = np.zeros(len(cursors), np.int32)
+        for r, cursor in enumerate(cursors):
+            ctr, actor = parse_op_id(cursor["elemId"])
+            if actor not in self.actors:
+                raise KeyError(f"List element not found: {cursor['elemId']}")
+            ctrs[r] = ctr
+            acts[r] = self.actors.id_of(actor)
+        idxs, founds = K.resolve_cursor_indices_batch(
+            self.states, jax.numpy.asarray(ctrs), jax.numpy.asarray(acts)
+        )
+        founds = np.asarray(founds)
+        if not founds.all():
+            bad = int(np.flatnonzero(~founds)[0])
+            raise KeyError(f"List element not found: {cursors[bad]['elemId']}")
+        return [int(i) for i in np.asarray(idxs)]
+
     def clock(self, replica: str | int) -> Dict[str, int]:
         r = replica if isinstance(replica, int) else self.index_of[replica]
         return dict(self.clocks[r])

@@ -43,3 +43,34 @@ def test_cursor_out_of_bounds():
     _, uni = build("ab")
     with pytest.raises(IndexError):
         uni.get_cursor("doc1", 99)
+
+
+def test_batched_cursor_round_trip_across_fleet():
+    """get_cursors/resolve_cursors: one launch per direction for the whole
+    fleet, agreeing with the per-replica API and surviving edits."""
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.testing import generate_docs
+
+    docs, _, genesis = generate_docs("cursor fleet", count=2)
+    d1, d2 = docs
+    uni = TpuUniverse(["a", "b", "c"])
+    uni.apply_changes({n: [genesis] for n in "abc"})
+    cursors = uni.get_cursors([2, 5, 0])
+    for r, idx in enumerate([2, 5, 0]):
+        assert cursors[r] == uni.get_cursor(r, idx)
+    assert uni.resolve_cursors(cursors) == [2, 5, 0]
+
+    # Inserts before the cursor shift it; after don't (micromerge.ts
+    # cursor-stability tests).
+    c, _ = d1.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": list("xx")}]
+    )
+    uni.apply_changes({"a": [c], "b": [c], "c": [c]})
+    assert uni.resolve_cursors(cursors) == [4, 7, 0]
+
+    import pytest
+
+    with pytest.raises(IndexError):
+        uni.get_cursors([2, 999, 0])
+    with pytest.raises(ValueError, match="one index per replica"):
+        uni.get_cursors([1])
