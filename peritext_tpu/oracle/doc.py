@@ -37,7 +37,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from peritext_tpu.ids import compare_op_ids, make_op_id, op_sort_key, parse_op_id
+from peritext_tpu.ids import compare_op_ids, make_op_id, op_sort_key
 from peritext_tpu.schema import MARK_SPEC
 
 # Sentinels.  ROOT is the document root object id; HEAD is the "insert at
