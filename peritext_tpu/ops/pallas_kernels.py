@@ -41,9 +41,11 @@ REPLICA_BLOCK = 8
 
 def _resolve_interpret(interpret):
     """None -> interpret everywhere but real TPU backends (pallas_call
-    compiles only there; CPU runs the interpreter)."""
+    compiles only there; CPU runs the interpreter).  The relayed TPU
+    backend on this image registers as platform "axon" — it is a real TPU
+    with remote Mosaic compilation, so it counts as a compile target."""
     if interpret is None:
-        return jax.default_backend() != "tpu"
+        return jax.default_backend() not in ("tpu", "axon")
     return interpret
 
 

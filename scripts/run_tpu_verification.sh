@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# One-command TPU verification sweep — run when the TPU relay serves.
+#
+# Produces, in ./tpu_verification/:
+#   bench_sorted.json     headline bench on the default (TPU) platform
+#   bench_scan.json       same workload on the sequential scan path
+#   bench_pallas.json     same workload on the VMEM Pallas merge
+#   pallas_hw.txt         Pallas differential tests with interpret=False
+#   config4.json config5.json   BASELINE configs at hardware scale
+#   profile/              jax.profiler device trace of one bench run
+#
+# Every step is supervised with a timeout so a wedged relay can't hang the
+# sweep; partial results are kept.
+set -u
+cd "$(dirname "$0")/.."
+OUT=tpu_verification
+mkdir -p "$OUT"
+
+run() { # name timeout cmd...
+  local name=$1 t=$2; shift 2
+  echo "== $name"
+  timeout "$t" "$@" >"$OUT/$name" 2>"$OUT/$name.err" \
+    && echo "   ok" || echo "   FAILED (see $OUT/$name.err)"
+}
+
+run bench_sorted.json 1800 python3 bench.py
+run bench_scan.json 1800 env BENCH_PATH=scan python3 bench.py
+run bench_pallas.json 1800 env BENCH_PALLAS=1 python3 bench.py
+
+# Pallas differential on hardware: conftest pins tests to cpu, so override,
+# and force compiled (non-interpret) kernels via the ambient TPU backend.
+run pallas_hw.txt 1800 env PERITEXT_TEST_PLATFORM=axon \
+  python3 -m pytest tests/test_pallas.py -q
+
+run config5.json 3600 env \
+  CONFIG5_REPLICAS="${CONFIG5_REPLICAS:-100000}" \
+  CONFIG5_DOC_LEN="${CONFIG5_DOC_LEN:-10000}" \
+  python3 -m peritext_tpu.bench.configs --config 5 --platform ambient
+run config4.json 3600 python3 -m peritext_tpu.bench.configs --config 4 --platform ambient
+
+run bench_profiled.json 1800 env PERITEXT_PROFILE="$OUT/profile" \
+  BENCH_REPLICAS=1024 python3 bench.py
+
+echo "== summary"
+grep -h '"metric"\|"config"' "$OUT"/*.json 2>/dev/null || true

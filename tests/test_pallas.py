@@ -1,6 +1,8 @@
 """The Pallas text-phase kernel must agree bit-for-bit with the XLA path.
 
-Runs in interpret mode on CPU (real compilation happens on TPU hardware).
+Runs in interpret mode on CPU; on a TPU backend (platform "tpu" or the
+relayed "axon") the same tests compile under Mosaic — run with
+PERITEXT_TEST_PLATFORM=axon for the hardware verification pass.
 """
 import jax.numpy as jnp
 import numpy as np
@@ -26,7 +28,7 @@ def test_pallas_merge_matches_xla(merge_fn, seed):
     states = batch["states"]
 
     ref = K.merge_step_batch(states, text_ops, mark_ops, ranks)
-    out = merge_fn(states, text_ops, mark_ops, ranks, interpret=True)
+    out = merge_fn(states, text_ops, mark_ops, ranks, interpret=None)
 
     import dataclasses
 
@@ -67,7 +69,7 @@ def test_pallas_fused_runs_match_xla(seed):
         batch["states"], fused_text, mark_ops, ranks, char_bufs
     )
     out = merge_step_pallas_full(
-        batch["states"], fused_text, mark_ops, ranks, char_buf=char_bufs, interpret=True
+        batch["states"], fused_text, mark_ops, ranks, char_buf=char_bufs, interpret=None
     )
 
     import dataclasses
@@ -97,7 +99,7 @@ def test_pallas_run_rows_without_buffer_raise():
             st.length,
             jnp.asarray(text_ops),
             jnp.asarray(batch["ranks"]),
-            interpret=True,
+            interpret=None,
         )
 
 
@@ -110,5 +112,5 @@ def test_pallas_rejects_misaligned_shapes():
             jnp.asarray(batch["text_ops"]),
             jnp.asarray(batch["mark_ops"]),
             jnp.asarray(batch["ranks"]),
-            interpret=True,
+            interpret=None,
         )
