@@ -1,0 +1,67 @@
+"""North-star doc-length correctness: 10k-char replicas, oracle-exact.
+
+BASELINE's north star merges 10k-char replica pairs; this is the
+correctness half at that document length (the throughput half is the
+bench).  ~20s on CPU, so it is opt-in: PERITEXT_SLOW=1 pytest tests/test_north_star.py
+"""
+import os
+import random
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PERITEXT_SLOW") != "1", reason="slow; set PERITEXT_SLOW=1"
+)
+
+
+def test_ten_k_char_docs_merge_oracle_exact():
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.ops import TpuUniverse
+
+    rng = random.Random(42)
+    text = "".join(rng.choice("abcdefgh \n") for _ in range(10_000))
+    base = Doc("base")
+    genesis, _ = base.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list(text)},
+        ]
+    )
+    writers = []
+    for name in ("w1", "w2"):
+        w = Doc(name)
+        w.apply_change(genesis)
+        ops = []
+        for _ in range(20):
+            i = rng.randrange(9000)
+            kind = rng.random()
+            if kind < 0.5:
+                ops.append(
+                    {"path": ["text"], "action": "insert", "index": i, "values": list("XYZ")}
+                )
+            elif kind < 0.75:
+                ops.append({"path": ["text"], "action": "delete", "index": i, "count": 5})
+            else:
+                op = {
+                    "path": ["text"],
+                    "action": "addMark",
+                    "startIndex": i,
+                    "endIndex": i + rng.randrange(1, 2000),
+                    "markType": rng.choice(["strong", "em", "link"]),
+                }
+                if op["markType"] == "link":
+                    op["attrs"] = {"url": "http://u"}
+                ops.append(op)
+        c, _ = w.change(ops)
+        writers.append((w, c))
+    (w1, c1), (w2, c2) = writers
+    w1.apply_change(c2)
+    w2.apply_change(c1)
+
+    uni = TpuUniverse(["a", "b"], capacity=16384, max_mark_ops=64)
+    uni.apply_changes({"a": [genesis], "b": [genesis]})
+    uni.apply_changes({"a": [c1, c2], "b": [c2, c1]})
+    assert uni.spans("a") == w1.get_text_with_formatting(["text"])
+    assert uni.spans("b") == w2.get_text_with_formatting(["text"])
+    digests = uni.digests()
+    assert digests[0] == digests[1]
