@@ -202,6 +202,55 @@ class TpuUniverse:
             "dispatch_seconds": 0.0,
         }
 
+    # -- fleet elasticity ---------------------------------------------------
+
+    def add_replicas(self, names: Sequence[str]) -> None:
+        """Grow the fleet with fresh (empty) replicas.
+
+        The elastic-recovery story (SURVEY §5): a new replica joins empty
+        and catches up by ingesting ``ChangeLog.missing_changes(log.clock(),
+        {})`` through the normal causal gate — exactly how the reference
+        reconstructs any replica from the durable change log.
+        """
+        fresh = [n for n in names]
+        for n in fresh:
+            if n in self.index_of:
+                raise ValueError(f"replica {n!r} already exists")
+        if not fresh:
+            return
+        empty = stack_states(
+            [make_empty_state(self.capacity, self.max_mark_ops) for _ in fresh]
+        )
+        self.states = jax.tree.map(
+            lambda a, b: jax.numpy.concatenate([a, b]), self.states, empty
+        )
+        for n in fresh:
+            self.index_of[n] = len(self.replica_ids)
+            self.replica_ids.append(n)
+            self.clocks.append({})
+            self.lengths.append(0)
+            self.mark_counts.append(0)
+            self.roots.append({})
+
+    def drop_replicas(self, names: Sequence[str]) -> None:
+        """Shrink the fleet (one gather; dropped replicas' state is gone —
+        durable history lives in the change log, not the fleet)."""
+        drop = set(names)
+        missing = drop - set(self.replica_ids)
+        if missing:
+            raise KeyError(f"unknown replicas: {sorted(missing)}")
+        keep = [i for i, n in enumerate(self.replica_ids) if n not in drop]
+        if not keep:
+            raise ValueError("cannot drop every replica")
+        idx = jax.numpy.asarray(np.asarray(keep, np.int32))
+        self.states = jax.tree.map(lambda x: x[idx], self.states)
+        self.replica_ids = [self.replica_ids[i] for i in keep]
+        self.index_of = {n: i for i, n in enumerate(self.replica_ids)}
+        self.clocks = [self.clocks[i] for i in keep]
+        self.lengths = [self.lengths[i] for i in keep]
+        self.mark_counts = [self.mark_counts[i] for i in keep]
+        self.roots = [self.roots[i] for i in keep]
+
     # -- capacity management ------------------------------------------------
 
     def _ensure_capacity(self, need_len: int, need_marks: int) -> None:

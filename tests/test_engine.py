@@ -431,3 +431,48 @@ def test_spans_batch_matches_per_replica_spans():
         assert batch[r] == uni.spans(name), name
     assert batch[0] == batch[1]
     assert batch[2] != batch[0]
+
+
+def test_elastic_add_and_drop_replicas():
+    """Fleet elasticity: a replica joining late catches up from the change
+    log through the normal gate and converges; dropping replicas leaves
+    the rest intact (SURVEY §5 elastic-recovery analog)."""
+    docs, _, genesis = generate_docs("elastic fleet")
+    doc1, _ = docs
+    log = ChangeLog()
+    log.record(genesis)
+    uni = TpuUniverse(["a", "b"])
+    uni.apply_changes({"a": [genesis], "b": [genesis]})
+    c1, _ = doc1.change(
+        [
+            {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 7, "markType": "strong"},
+            {"path": ["text"], "action": "insert", "index": 3, "values": list("++")},
+        ]
+    )
+    log.record(c1)
+    uni.apply_changes({"a": [c1], "b": [c1]})
+
+    # Late joiner: empty state, catch up from the log's full frontier.
+    uni.add_replicas(["late"])
+    assert uni.text("late") == ""
+    uni.apply_changes({"late": log.missing_changes(log.clock(), uni.clock("late"))})
+    assert uni.spans("late") == uni.spans("a")
+    digests = uni.digests()
+    assert digests[0] == digests[1] == digests[2]
+
+    # Dropping a replica preserves the others bit-for-bit.
+    before = uni.spans("late")
+    uni.drop_replicas(["b"])
+    assert uni.replica_ids == ["a", "late"]
+    assert uni.spans("late") == before
+    # And the survivors keep ingesting normally.
+    c2, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 0, "values": ["!"]}])
+    uni.apply_changes({"a": [c2], "late": [c2]})
+    assert uni.text("a") == uni.text("late")
+
+    import pytest
+
+    with pytest.raises(ValueError, match="already exists"):
+        uni.add_replicas(["a"])
+    with pytest.raises(KeyError):
+        uni.drop_replicas(["ghost"])
