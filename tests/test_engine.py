@@ -476,3 +476,32 @@ def test_elastic_add_and_drop_replicas():
         uni.add_replicas(["a"])
     with pytest.raises(KeyError):
         uni.drop_replicas(["ghost"])
+
+
+def test_capacity_growth_mid_session():
+    """A batch that overflows the static capacity re-buckets the fleet
+    (capacity and mark-table doubling) and stays oracle-exact — through
+    the sorted path, whose run blocks can exceed the original capacity."""
+    docs, _, genesis = generate_docs("tiny")
+    doc1, _ = docs
+    uni = TpuUniverse(["a", "b"], capacity=32, max_mark_ops=32)
+    uni.apply_changes({"a": [genesis], "b": [genesis]})
+    assert uni.capacity == 32
+
+    paste, _ = doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 2, "values": list("x" * 100)}]
+    )
+    marks = []
+    w = doc1
+    for i in range(40):  # overflow the 32-op mark table too
+        c, _ = w.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": i, "endIndex": i + 3,
+              "markType": "strong" if i % 2 else "em"}]
+        )
+        marks.append(c)
+    uni.apply_changes({"a": [paste] + marks, "b": [paste] + marks})
+    assert uni.capacity >= 128 and uni.max_mark_ops >= 64
+    assert uni.stats["capacity_growths"] >= 1
+    assert uni.spans("a") == doc1.get_text_with_formatting(["text"])
+    digests = uni.digests()
+    assert digests[0] == digests[1]
