@@ -223,3 +223,33 @@ def test_sharded_patch_path_matches_single_device(batch):
         assert (a == b).all(), f"patched sharded: field {field.name} diverged"
     for key in ref_records:
         assert (np.asarray(records[key]) == np.asarray(ref_records[key])).all(), key
+
+
+def test_elastic_add_replicas_on_sharded_fleet():
+    """add_replicas on a mesh-sharded universe: the concatenated batch
+    stays usable for merges and digests (GSPMD reshards as needed)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.parallel import shard_states
+    from peritext_tpu.testing import generate_docs
+
+    docs, _, genesis = generate_docs("sharded elastic")
+    doc1, _ = docs
+    names = [f"r{i}" for i in range(8)]
+    uni = TpuUniverse(names)
+    uni.apply_changes({n: [genesis] for n in names})
+    mesh = make_mesh(jax.devices()[:8], 8, 1)
+    uni.states = shard_states(uni.states, mesh, shard_seq=False)
+
+    uni.add_replicas(["late0", "late1"])
+    c, _ = doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": list("hi ")}]
+    )
+    batch = {n: [c] for n in names}
+    batch["late0"] = [genesis, c]
+    batch["late1"] = [genesis, c]
+    uni.apply_changes(batch)
+    digests = uni.digests()
+    assert (digests == digests[0]).all()
+    assert uni.text("late1") == uni.text("r0")
