@@ -57,6 +57,15 @@ def main() -> None:
         tpu, path = measure_with_fallback()
     scalar = time_scalar_baseline(doc_len=doc_len, ops_per_merge=ops_per_merge)
 
+    # BASELINE's second tracked metric: p50 merge latency @ 10k-char doc.
+    try:
+        from peritext_tpu.bench.workloads import time_merge_latency
+
+        latency = time_merge_latency()
+    except Exception as err:
+        print(f"bench: latency measurement failed: {err}", file=sys.stderr)
+        latency = None
+
     import jax
 
     result = {
@@ -67,6 +76,8 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
         "path": path,
     }
+    if latency is not None:
+        result["p50_merge_latency_ms_10k_doc"] = latency["p50_ms"]
     print(json.dumps(result))
     sys.stdout.flush()
 

@@ -1,0 +1,71 @@
+"""Randomized multi-session soak of the production (sorted) merge path.
+
+40 sessions x up to 4 replicas x random concurrent op streams, each
+cross-applied in per-replica shuffled interleavings: engine spans must
+equal the oracle's everywhere and digests must agree.  Opt-in (a few
+minutes): PERITEXT_SLOW=1 pytest tests/test_soak.py
+"""
+import os
+import random
+
+import pytest
+
+from peritext_tpu.fuzz import (
+    _random_add_mark,
+    _random_delete,
+    _random_insert,
+    _random_remove_mark,
+)
+from peritext_tpu.testing import generate_docs
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PERITEXT_SLOW") != "1", reason="slow; set PERITEXT_SLOW=1"
+)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_sorted_path_soak_session(seed):
+    from peritext_tpu.ops import TpuUniverse
+
+    rng = random.Random(1000 + seed)
+    n = rng.choice([2, 3, 4])
+    docs, _, genesis = generate_docs("fuzz the sorted path", count=n)
+    comment_history = []
+    streams = {d.actor_id: [] for d in docs}
+    for d in docs:
+        for _ in range(rng.randint(1, 12)):
+            kind = rng.random()
+            if kind < 0.4:
+                op = _random_insert(rng, d, rng.choice([1, 3, 8]))
+            elif kind < 0.6:
+                op = _random_delete(rng, d)
+            elif kind < 0.85:
+                op = _random_add_mark(rng, d, comment_history)
+            else:
+                op = _random_remove_mark(rng, d, comment_history, False)
+            if op is None:
+                continue
+            change, _ = d.change([op])
+            streams[d.actor_id].append(change)
+
+    orders = {}
+    for d in docs:
+        others = [a for a in streams if a != d.actor_id]
+        rng.shuffle(others)
+        delivered = []
+        for a in others:
+            delivered.extend(streams[a])
+        orders[d.actor_id] = delivered
+        for c in delivered:
+            d.apply_change(c)
+
+    uni = TpuUniverse([d.actor_id for d in docs], capacity=256)
+    uni.apply_changes({d.actor_id: [genesis] for d in docs})
+    uni.apply_changes({d.actor_id: streams[d.actor_id] for d in docs})
+    uni.apply_changes(orders)
+    for d in docs:
+        assert uni.spans(d.actor_id) == d.get_text_with_formatting(["text"]), (
+            f"seed {seed} {d.actor_id}"
+        )
+    digests = uni.digests()
+    assert (digests == digests[0]).all(), f"seed {seed} digests diverged"
