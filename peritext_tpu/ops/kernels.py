@@ -820,6 +820,11 @@ def _apply_marks_batch(
 ):
     """Apply a causally-ordered mark-op batch to the boundary tables at once.
 
+    The batch closed form of the reference's applyAddRemoveMark walk
+    (peritext.ts:154-223) under the write-class derivation documented on
+    _apply_mark: same anchor rules (including the same-slot -> endOfText
+    walk-order subtlety, peritext.ts:236-241) and the same carried
+    ``currentOps`` semantics, resolved for all ops simultaneously.
     Bit-exact with scanning _apply_mark_fast over the same rows (differential
     coverage in tests/test_sorted_merge.py).  Returns (bnd_def, bnd_mask).
     """
