@@ -52,6 +52,9 @@ def main() -> None:
 
     total_ops = 0
     wall = 0.0
+    # The host/device split reports the measured rounds only, so exclude
+    # the genesis bootstrap's host share accumulated above.
+    host_before_rounds = uni.stats["host_seconds"]
     for rnd in range(rounds):
         # Each replica merges one writer stream per round, round-robin — so
         # after every round, replicas on the same stream schedule must agree.
@@ -86,7 +89,7 @@ def main() -> None:
     spans = uni.spans(names[0])
     text = "".join(s["text"] for s in spans)
     marked = sum(1 for s in spans if s["marks"])
-    host_s = uni.stats["host_seconds"]
+    host_s = uni.stats["host_seconds"] - host_before_rounds
     # Device share = barriered round wall time minus the host control plane
     # (dispatch_seconds alone would miss async execution).
     dev_s = max(wall - host_s, 0.0)

@@ -189,13 +189,11 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
     def merge_and_digest(states, shift):
         # Distinct op ids per invocation (counters shifted; refs into the
         # genesis doc untouched) so no layer can serve cached results.
+        from peritext_tpu.bench.workloads import shift_op_ids
+
         genesis_max = workload["genesis"]["startOp"] + len(workload["genesis"]["ops"]) - 1
-        text = np.array(text_np)
-        marks = np.array(batch["mark_ops"])
-        for arr in (text, marks):
-            arr[..., K.K_CTR] += (arr[..., K.K_CTR] > 0) * shift
-            for field in (K.K_REF_CTR, K.K_SCTR, K.K_ECTR):
-                arr[..., field] += (arr[..., field] > genesis_max) * shift
+        text = shift_op_ids(text_np, shift, genesis_max)
+        marks = shift_op_ids(batch["mark_ops"], shift, genesis_max)
         out = K.merge_step_sorted_batch(
             states,
             jnp.asarray(text),

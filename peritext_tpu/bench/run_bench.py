@@ -78,6 +78,7 @@ def main() -> None:
     }
     if latency is not None:
         result["p50_merge_latency_ms_10k_doc"] = latency["p50_ms"]
+        result["latency_path"] = latency["path"]
     print(json.dumps(result))
     sys.stdout.flush()
 

@@ -324,16 +324,35 @@ class TpuUniverse:
         output is a group index, and the expensive Python/string work runs
         once per group instead of once per replica.
         """
+        import hashlib
+        import json as _json
+
         n = len(batches)
         groups: List[Dict[str, Any]] = []
         memo: Dict[Any, int] = {}
         group_of = np.zeros(n, np.int32)
         n_ingested = 0
+        # Group identity is change *content* (canonical-JSON digest), not
+        # object identity, so per-replica deserialized copies of the same
+        # stream (catch-up sync) still share one gate/encode pass.  The
+        # digest is cached by object id for the duration of this call, so
+        # the common shared-list fleet case hashes each change once total.
+        hash_by_id: Dict[int, str] = {}
+
+        def change_digest(c: Change) -> str:
+            h = hash_by_id.get(id(c))
+            if h is None:
+                h = hashlib.sha1(
+                    _json.dumps(c, sort_keys=True, separators=(",", ":")).encode()
+                ).hexdigest()
+                hash_by_id[id(c)] = h
+            return h
+
         for r, changes in enumerate(batches):
             clock = self.clocks[r]
             text_obj = self.roots[r].get("__lists__", {}).get("text")
             key = (
-                tuple(map(id, changes)),
+                tuple(change_digest(c) for c in changes),
                 tuple(sorted(clock.items())),
                 text_obj,
             )
@@ -501,6 +520,14 @@ class TpuUniverse:
                 sorted_prep["maxk"],
             )
         self.stats["dispatch_seconds"] += _time.perf_counter() - t_dev
+        if os.environ.get("PERITEXT_STRICT_COMMIT") == "1":
+            # Execution barrier before the control-plane commit: JAX
+            # dispatch is async, so by default a launch that later fails
+            # on-device can leave committed clocks ahead of the state
+            # (surfacing at the next readback).  Strict mode trades
+            # pipelining for commit-after-*execution* — use it on flaky
+            # backends (e.g. the relayed TPU).
+            np.asarray(self.states.length)
         t_host = _time.perf_counter()
         self._commit(prep)
         self.stats["host_seconds"] += _time.perf_counter() - t_host

@@ -36,11 +36,13 @@ class ChangeLog:
         a mismatch means a forked actor history or a corrupted log, which
         must surface rather than silently drop.
         """
-        queue = self._queues.setdefault(change["actor"], [])
         if change["seq"] < 1:
+            # Validate before touching the log: a rejected record must not
+            # create a phantom actor entry in clock()/missing_changes.
             raise ValueError(
                 f"Invalid seq {change['seq']} for {change['actor']}: seqs are 1-based"
             )
+        queue = self._queues.setdefault(change["actor"], [])
         if change["seq"] == len(queue) + 1:
             queue.append(change)
         elif change["seq"] > len(queue) + 1:

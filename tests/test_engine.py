@@ -505,3 +505,25 @@ def test_capacity_growth_mid_session():
     assert uni.spans("a") == doc1.get_text_with_formatting(["text"])
     digests = uni.digests()
     assert digests[0] == digests[1]
+
+
+def test_group_memoization_shares_equal_content_distinct_objects():
+    """Per-replica deserialized copies of the same stream (distinct dict
+    objects, equal content) must share one gate/encode group."""
+    import json
+
+    docs, _, genesis = generate_docs("dedup")
+    doc1, _ = docs
+    c1, _ = doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["z"]}]
+    )
+    uni = TpuUniverse([f"r{i}" for i in range(6)])
+    # Each replica gets its own deep copy, as a real catch-up sync would.
+    batch = {
+        f"r{i}": [json.loads(json.dumps(genesis)), json.loads(json.dumps(c1))]
+        for i in range(6)
+    }
+    prep = uni._prepare(uni._normalize_batches(batch))
+    assert len(prep["groups"]) == 1, "equal-content batches split into groups"
+    uni.apply_changes(batch)
+    assert all(t == uni.text("r0") for t in uni.texts())
