@@ -22,6 +22,7 @@ ops within a causal batch while replicas stay embarrassingly parallel.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -1036,14 +1037,11 @@ def merge_step_sorted(
     )
 
 
-import functools as _functools
-
-
-@_functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=None)
 def _merge_step_sorted_batch(maxk: int):
     return jax.jit(
         jax.vmap(
-            _functools.partial(merge_step_sorted, maxk=maxk),
+            functools.partial(merge_step_sorted, maxk=maxk),
             in_axes=(0, 0, 0, None, 0, None, 0),
         )
     )

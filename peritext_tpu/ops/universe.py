@@ -15,6 +15,11 @@ algebra, mark resolution, digests.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import math
+import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -324,9 +329,6 @@ class TpuUniverse:
         output is a group index, and the expensive Python/string work runs
         once per group instead of once per replica.
         """
-        import hashlib
-        import json as _json
-
         n = len(batches)
         groups: List[Dict[str, Any]] = []
         memo: Dict[Any, int] = {}
@@ -343,7 +345,7 @@ class TpuUniverse:
             h = hash_by_id.get(id(c))
             if h is None:
                 h = hashlib.sha1(
-                    _json.dumps(c, sort_keys=True, separators=(",", ":")).encode()
+                    json.dumps(c, sort_keys=True, separators=(",", ":")).encode()
                 ).hexdigest()
                 hash_by_id[id(c)] = h
             return h
@@ -391,6 +393,13 @@ class TpuUniverse:
             "need_len": int(lengths.max(initial=0)),
             "need_marks": int(mark_counts.max(initial=0)),
         }
+
+    def _account_rows(self, groups, group_of):
+        """Per-group replica counts + row counts; tallies ops_applied."""
+        sizes = np.bincount(group_of, minlength=len(groups))
+        row_counts = np.asarray([g["rows"].shape[0] for g in groups], np.int64)
+        self.stats["ops_applied"] += int((row_counts * sizes).sum())
+        return sizes, row_counts
 
     def _commit(self, prep: Dict[str, Any]) -> None:
         """Publish a prepared batch's control-plane effects (post-launch)."""
@@ -440,10 +449,7 @@ class TpuUniverse:
         scan step per op.  Set PERITEXT_MERGE_PATH=scan to force the
         sequential two-phase scan path (debugging/differential runs).
         """
-        import os
-        import time as _time
-
-        t_host = _time.perf_counter()
+        t_host = time.perf_counter()
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
         groups, group_of = prep["groups"], prep["group_of"]
@@ -460,9 +466,7 @@ class TpuUniverse:
             text_rows_list.append(text_rows)
             mark_rows_list.append(mark_rows)
             max_mark = max(max_mark, mark_rows.shape[0])
-        group_sizes = np.bincount(group_of, minlength=len(groups))
-        row_counts = np.asarray([g["rows"].shape[0] for g in groups], np.int64)
-        self.stats["ops_applied"] += int((row_counts * group_sizes).sum())
+        group_sizes, _ = self._account_rows(groups, group_of)
 
         self._ensure_capacity(prep["need_len"], prep["need_marks"])
         if not any_rows:
@@ -498,7 +502,7 @@ class TpuUniverse:
             g_mark[:, :, K.K_KIND] == K.KIND_PAD
         ).sum(axis=1)
         self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
-        t_dev = _time.perf_counter()
+        t_dev = time.perf_counter()
         self.stats["host_seconds"] += t_dev - t_host
         if use_scan:
             self.states = K.merge_step_fused_batch(
@@ -519,7 +523,7 @@ class TpuUniverse:
                 jax.numpy.asarray(bufs),
                 sorted_prep["maxk"],
             )
-        self.stats["dispatch_seconds"] += _time.perf_counter() - t_dev
+        self.stats["dispatch_seconds"] += time.perf_counter() - t_dev
         if os.environ.get("PERITEXT_STRICT_COMMIT") == "1":
             # Execution barrier before the control-plane commit: JAX
             # dispatch is async, so by default a launch that later fails
@@ -528,9 +532,9 @@ class TpuUniverse:
             # pipelining for commit-after-*execution* — use it on flaky
             # backends (e.g. the relayed TPU).
             np.asarray(self.states.length)
-        t_host = _time.perf_counter()
+        t_host = time.perf_counter()
         self._commit(prep)
-        self.stats["host_seconds"] += _time.perf_counter() - t_host
+        self.stats["host_seconds"] += time.perf_counter() - t_host
 
     def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:
         """Structural map ops (makeList/makeMap/set/del on the root map).
@@ -563,9 +567,7 @@ class TpuUniverse:
                 for op in g["host_ops"]
                 if op["action"] == "makeList"
             ]
-        group_sizes = np.bincount(group_of, minlength=len(groups))
-        row_counts = np.asarray([g["rows"].shape[0] for g in groups], np.int64)
-        self.stats["ops_applied"] += int((row_counts * group_sizes).sum())
+        group_sizes, row_counts = self._account_rows(groups, group_of)
         max_rows = int(row_counts.max(initial=0))
 
         self._ensure_capacity(prep["need_len"], prep["need_marks"])
@@ -590,11 +592,8 @@ class TpuUniverse:
         # before the next chunk's launch.  Device state is immutable, so a
         # mid-chunk failure rolls back to the pre-batch pytree and nothing
         # commits (same atomicity contract as the fast path).
-        import math as _math
-        import os as _os
-
         n = len(self.replica_ids)
-        raw = _os.environ.get("PERITEXT_PATCH_CHUNK", "0")
+        raw = os.environ.get("PERITEXT_PATCH_CHUNK", "0")
         try:
             chunk = int(raw)
         except ValueError:
@@ -604,7 +603,7 @@ class TpuUniverse:
         chunk = chunk or n
         # Equalize chunk sizes where possible so the jit caches hold at most
         # two program shapes (the even chunks and one smaller tail).
-        chunk = _math.ceil(n / _math.ceil(n / chunk))
+        chunk = math.ceil(n / math.ceil(n / chunk))
         prev_states = self.states
         try:
             state_slices = []

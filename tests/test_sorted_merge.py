@@ -19,8 +19,6 @@ from peritext_tpu.ops.encode import (
     compute_rounds,
     encode_changes,
     fuse_insert_runs,
-    pad_buffer,
-    pad_rows,
     split_rows,
 )
 from peritext_tpu.ops.state import make_empty_state, stack_states
