@@ -8,7 +8,7 @@ sequence (capacity) dimension optionally sharded for very long documents —
 XLA GSPMD inserts the ICI collectives (prefix-scan exchanges, argmax
 reductions) that the sequence-sharded kernels need.
 """
-from peritext_tpu.parallel.shard import flatten_sources_sp
+from peritext_tpu.parallel.shard import flatten_sources_sp, place_text_sp
 from peritext_tpu.parallel.mesh import (
     make_mesh,
     shard_states,
@@ -24,4 +24,5 @@ __all__ = [
     "sharded_digest_reduce",
     "state_sharding",
     "flatten_sources_sp",
+    "place_text_sp",
 ]

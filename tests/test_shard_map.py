@@ -31,3 +31,127 @@ def test_shard_map_flatten_matches_single_device(seq):
 
     assert (np.asarray(mask) == np.asarray(ref_mask)).all()
     assert (np.asarray(has) == np.asarray(ref_has)).all()
+
+
+@pytest.mark.parametrize("seq", [2, 4])
+def test_shard_map_placement_matches_unsharded(seq):
+    """Explicit sequence-parallel sort-based placement (pmin stops + halo
+    ppermute splices) must equal the unsharded placement bit-for-bit,
+    including blocks straddling shard edges and multi-round chains."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+    from peritext_tpu.parallel.shard import place_text_sp
+
+    workload = make_merge_workload(doc_len=120, ops_per_merge=48, num_streams=4,
+                                   with_marks=False, seed=11)
+    batch = build_device_batch(workload, num_replicas=8, capacity=256, max_mark_ops=64)
+    sp = prepare_sorted_batch([batch["text_ops"][r] for r in range(8)])
+    states = batch["states"]
+    ranks = jnp.asarray(batch["ranks"])
+
+    ref = K.place_text_batch(
+        states.elem_ctr[0], states.elem_act[0], states.deleted[0], states.chars[0],
+        states.length[0],
+        jnp.asarray(sp["text"][0]), jnp.asarray(sp["rounds"][0]),
+        jnp.int32(sp["num_rounds"]), ranks, jnp.asarray(sp["bufs"][0]), sp["maxk"],
+    )
+    refs = [
+        jax.vmap(
+            lambda st_ec, st_ea, st_dl, st_ch, st_ln, t, ro, b: K.place_text_batch(
+                st_ec, st_ea, st_dl, st_ch, st_ln, t, ro,
+                jnp.int32(sp["num_rounds"]), ranks, b, sp["maxk"],
+            )
+        )(states.elem_ctr, states.elem_act, states.deleted, states.chars,
+          states.length, jnp.asarray(sp["text"]), jnp.asarray(sp["rounds"]),
+          jnp.asarray(sp["bufs"]))
+    ][0]
+
+    # Insert budget bounds the halo; bucket it like the caller would.
+    total_inserts = int(
+        (sp["text"][..., K.K_KIND] == K.KIND_INSERT).sum(axis=1).max()
+        + (
+            sp["text"][..., K.K_RUN_LEN]
+            * (sp["text"][..., K.K_KIND] == K.KIND_INSERT_RUN)
+        ).sum(axis=1).max()
+    )
+    halo = 1
+    while halo < max(total_inserts, 8):
+        halo *= 2
+
+    mesh = make_mesh(jax.devices()[:8], 8 // seq, seq)
+    from peritext_tpu.parallel import shard_states
+
+    sharded = shard_states(states, mesh)
+    fn = place_text_sp(mesh, halo=halo, maxk=sp["maxk"])
+    out = fn(
+        sharded.elem_ctr, sharded.elem_act, sharded.deleted, sharded.chars,
+        sharded.length, jnp.asarray(sp["text"]), jnp.asarray(sp["rounds"]),
+        jnp.int32(sp["num_rounds"]), ranks, jnp.asarray(sp["bufs"]),
+    )
+    names = ["elem_ctr", "elem_act", "deleted", "chars", "orig_idx", "length"]
+    for name, a, b in zip(names, refs, out):
+        assert (np.asarray(a) == np.asarray(b)).all(), f"seq={seq}: {name} diverged"
+
+
+def test_shard_map_placement_paste_spans_shards():
+    """A fused paste block wider than a shard (one KIND_INSERT_RUN row
+    landing across several seq shards) must splice exactly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from peritext_tpu.ids import ActorRegistry
+    from peritext_tpu.ops.encode import (
+        AttrRegistry,
+        encode_changes,
+        prepare_sorted_batch,
+        split_rows,
+    )
+    from peritext_tpu.ops.state import make_empty_state, stack_states
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.parallel import shard_states
+    from peritext_tpu.parallel.shard import place_text_sp
+
+    base = Doc("base")
+    genesis, _ = base.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("abcdefgh")},
+        ]
+    )
+    w = Doc("w")
+    w.apply_change(genesis)
+    paste, _ = w.change(
+        [{"path": ["text"], "action": "insert", "index": 3, "values": list("XY" * 40)}]
+    )
+    actors, attrs = ActorRegistry(), AttrRegistry()
+    grows, _, _ = encode_changes([genesis], actors, attrs)
+    rows, _, _ = encode_changes([paste], actors, attrs, text_obj=genesis["ops"][0]["opId"])
+    ranks_np = np.zeros(8, np.int32)
+    rk = actors.ranks()
+    ranks_np[: len(rk)] = rk
+    ranks = jnp.asarray(ranks_np)
+    st = K.apply_ops_jit(make_empty_state(128, 32), jnp.asarray(grows), ranks)
+    states = stack_states([st] * 4)
+    t_rows, _ = split_rows(rows)
+    sp = prepare_sorted_batch([t_rows] * 4)
+    assert sp["maxk"] >= 80  # one 80-char block > 32-wide shards
+
+    ref = K.place_text_batch(
+        st.elem_ctr, st.elem_act, st.deleted, st.chars, st.length,
+        jnp.asarray(sp["text"][0]), jnp.asarray(sp["rounds"][0]),
+        jnp.int32(sp["num_rounds"]), ranks, jnp.asarray(sp["bufs"][0]), sp["maxk"],
+    )
+    mesh = make_mesh(jax.devices()[:8], 2, 4)  # Cl = 32 < block width
+    sh = shard_states(states, mesh)
+    # halo >= the insert budget (80 chars) forces multi-hop ppermute pulls
+    # since each shard is only 32 wide.
+    fn = place_text_sp(mesh, halo=128, maxk=sp["maxk"])
+    out = fn(
+        sh.elem_ctr, sh.elem_act, sh.deleted, sh.chars, sh.length,
+        jnp.asarray(sp["text"]), jnp.asarray(sp["rounds"]),
+        jnp.int32(sp["num_rounds"]), ranks, jnp.asarray(sp["bufs"]),
+    )
+    for name, a, b in zip(
+        ["elem_ctr", "elem_act", "deleted", "chars", "orig_idx", "length"], ref, out
+    ):
+        assert (np.asarray(a) == np.asarray(b)[0]).all(), f"paste: {name} diverged"
