@@ -971,6 +971,45 @@ def _append_mark_table(state_fields, mark_ops, mark_count, m_cap):
     )
 
 
+def _sorted_tail(
+    state: DocState, elem_ctr, elem_act, deleted, chars, orig_idx, length, mark_ops
+) -> DocState:
+    """Post-placement tail shared by the sorted merges: boundary permute +
+    batched mark phase + table append, per replica."""
+    bnd_def, bnd_mask = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+    bnd_def, bnd_mask = _apply_marks_batch(
+        bnd_def,
+        bnd_mask,
+        mark_ops,
+        elem_ctr,
+        elem_act,
+        length,
+        state.mark_count,
+        state.bnd_mask.shape[-1],
+    )
+    mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count = _append_mark_table(
+        (state.mark_ctr, state.mark_act, state.mark_action, state.mark_type, state.mark_attr),
+        mark_ops,
+        state.mark_count,
+        state.max_mark_ops,
+    )
+    return DocState(
+        elem_ctr=elem_ctr,
+        elem_act=elem_act,
+        deleted=deleted,
+        chars=chars,
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=mark_ctr,
+        mark_act=mark_act,
+        mark_action=mark_action,
+        mark_type=mark_type,
+        mark_attr=mark_attr,
+        length=length,
+        mark_count=mark_count,
+    )
+
+
 def merge_step_sorted(
     state: DocState,
     text_ops: jax.Array,
@@ -1001,39 +1040,8 @@ def merge_step_sorted(
         char_buf,
         maxk,
     )
-    bnd_def, bnd_mask = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
-
-    bnd_def, bnd_mask = _apply_marks_batch(
-        bnd_def,
-        bnd_mask,
-        mark_ops,
-        elem_ctr,
-        elem_act,
-        length,
-        state.mark_count,
-        state.bnd_mask.shape[-1],
-    )
-    mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count = _append_mark_table(
-        (state.mark_ctr, state.mark_act, state.mark_action, state.mark_type, state.mark_attr),
-        mark_ops,
-        state.mark_count,
-        state.max_mark_ops,
-    )
-
-    return DocState(
-        elem_ctr=elem_ctr,
-        elem_act=elem_act,
-        deleted=deleted,
-        chars=chars,
-        bnd_def=bnd_def,
-        bnd_mask=bnd_mask,
-        mark_ctr=mark_ctr,
-        mark_act=mark_act,
-        mark_action=mark_action,
-        mark_type=mark_type,
-        mark_attr=mark_attr,
-        length=length,
-        mark_count=mark_count,
+    return _sorted_tail(
+        state, elem_ctr, elem_act, deleted, chars, orig_idx, length, mark_ops
     )
 
 

@@ -8,7 +8,7 @@ sequence (capacity) dimension optionally sharded for very long documents —
 XLA GSPMD inserts the ICI collectives (prefix-scan exchanges, argmax
 reductions) that the sequence-sharded kernels need.
 """
-from peritext_tpu.parallel.shard import flatten_sources_sp, place_text_sp
+from peritext_tpu.parallel.shard import flatten_sources_sp, merge_step_sorted_sp, place_text_sp
 from peritext_tpu.parallel.mesh import (
     make_mesh,
     shard_states,
@@ -25,4 +25,5 @@ __all__ = [
     "state_sharding",
     "flatten_sources_sp",
     "place_text_sp",
+    "merge_step_sorted_sp",
 ]

@@ -286,6 +286,36 @@ def place_text_sp(mesh: Mesh, halo: int, maxk: int):
     return jax.jit(mapped)
 
 
+def merge_step_sorted_sp(mesh: Mesh, halo: int, maxk: int):
+    """Full sorted merge for the long-document regime: explicit-collective
+    text placement (place_text_sp) composed with the GSPMD-sharded tail
+    (boundary permute + batched mark phase — gathers and [2C, M] matmuls
+    that GSPMD partitions over the same mesh).  State-equivalent to
+    kernels.merge_step_sorted on the gathered arrays.
+    """
+    K = _K()
+    place = place_text_sp(mesh, halo=halo, maxk=maxk)
+
+    def step(states, text_ops, round_of, num_rounds, mark_ops, ranks, char_buf):
+        ec, ea, dl, ch, oi, ln = place(
+            states.elem_ctr,
+            states.elem_act,
+            states.deleted,
+            states.chars,
+            states.length,
+            text_ops,
+            round_of,
+            num_rounds,
+            ranks,
+            char_buf,
+        )
+        return jax.vmap(
+            K._sorted_tail, in_axes=(0, 0, 0, 0, 0, 0, 0, 0)
+        )(states, ec, ea, dl.astype(bool), ch, oi, ln, mark_ops)
+
+    return jax.jit(step)
+
+
 def flatten_sources_sp(mesh: Mesh):
     """shard_map-compiled sequence-parallel flatten over (replica, seq).
 

@@ -155,3 +155,38 @@ def test_shard_map_placement_paste_spans_shards():
         ["elem_ctr", "elem_act", "deleted", "chars", "orig_idx", "length"], ref, out
     ):
         assert (np.asarray(a) == np.asarray(b)[0]).all(), f"paste: {name} diverged"
+
+
+def test_merge_step_sorted_sp_matches_unsharded():
+    """The composed explicit-SP merge (placement + GSPMD tail, marks
+    included) equals the unsharded sorted merge on every state field."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    import dataclasses
+
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+    from peritext_tpu.parallel import shard_states
+    from peritext_tpu.parallel.shard import merge_step_sorted_sp
+
+    workload = make_merge_workload(doc_len=120, ops_per_merge=48, num_streams=4,
+                                   with_marks=True, seed=13)
+    batch = build_device_batch(workload, num_replicas=8, capacity=256, max_mark_ops=64)
+    sp = prepare_sorted_batch([batch["text_ops"][r] for r in range(8)])
+    ranks = jnp.asarray(batch["ranks"])
+    mark_ops = jnp.asarray(batch["mark_ops"])
+
+    ref = K.merge_step_sorted_batch(
+        batch["states"], jnp.asarray(sp["text"]), jnp.asarray(sp["rounds"]),
+        sp["num_rounds"], mark_ops, ranks, jnp.asarray(sp["bufs"]), sp["maxk"],
+    )
+    mesh = make_mesh(jax.devices()[:8], 4, 2)
+    sharded = shard_states(batch["states"], mesh)
+    fn = merge_step_sorted_sp(mesh, halo=128, maxk=sp["maxk"])
+    out = fn(
+        sharded, jnp.asarray(sp["text"]), jnp.asarray(sp["rounds"]),
+        jnp.int32(sp["num_rounds"]), mark_ops, ranks, jnp.asarray(sp["bufs"]),
+    )
+    for field in dataclasses.fields(ref):
+        a = np.asarray(getattr(ref, field.name))
+        b = np.asarray(getattr(out, field.name))
+        assert (a == b).all(), f"sp merge: field {field.name} diverged"
