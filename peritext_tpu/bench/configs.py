@@ -186,6 +186,24 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
     ranks = jnp.asarray(batch["ranks"])
     multi = jnp.asarray(allow_multiple_array())
 
+    # CONFIG5_EXPLICIT_SP=1 runs the explicit-collective merge (pmin +
+    # ppermute halo placement) instead of the GSPMD-auto sorted merge.
+    explicit_sp = os.environ.get("CONFIG5_EXPLICIT_SP") == "1"
+    if explicit_sp:
+        from peritext_tpu.parallel.shard import merge_step_sorted_sp
+
+        budget = int(
+            (text_np[..., K.K_KIND] == K.KIND_INSERT).sum(axis=1).max()
+            + (
+                text_np[..., K.K_RUN_LEN]
+                * (text_np[..., K.K_KIND] == K.KIND_INSERT_RUN)
+            ).sum(axis=1).max()
+        )
+        halo = 8
+        while halo < budget:
+            halo *= 2
+        sp_merge = merge_step_sorted_sp(mesh, halo=halo, maxk=sp["maxk"])
+
     def merge_and_digest(states, shift):
         # Distinct op ids per invocation (counters shifted; refs into the
         # genesis doc untouched) so no layer can serve cached results.
@@ -194,16 +212,27 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
         genesis_max = workload["genesis"]["startOp"] + len(workload["genesis"]["ops"]) - 1
         text = shift_op_ids(text_np, shift, genesis_max)
         marks = shift_op_ids(batch["mark_ops"], shift, genesis_max)
-        out = K.merge_step_sorted_batch(
-            states,
-            jnp.asarray(text),
-            jnp.asarray(rounds_np),
-            sp["num_rounds"],
-            jnp.asarray(marks),
-            ranks,
-            jnp.asarray(bufs_np),
-            sp["maxk"],
-        )
+        if explicit_sp:
+            out = sp_merge(
+                states,
+                jnp.asarray(text),
+                jnp.asarray(rounds_np),
+                jnp.int32(sp["num_rounds"]),
+                jnp.asarray(marks),
+                ranks,
+                jnp.asarray(bufs_np),
+            )
+        else:
+            out = K.merge_step_sorted_batch(
+                states,
+                jnp.asarray(text),
+                jnp.asarray(rounds_np),
+                sp["num_rounds"],
+                jnp.asarray(marks),
+                ranks,
+                jnp.asarray(bufs_np),
+                sp["maxk"],
+            )
         return out, np.asarray(K.convergence_digest_batch(out, ranks, multi))
 
     flatten = flatten_sources_sp(mesh)
@@ -229,6 +258,7 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
     total_ops = batch["total_ops"]
     return {
         "config": 5,
+        "merge": "explicit_sp" if explicit_sp else "gspmd_sorted",
         "workload": f"{replicas} replicas x {doc_len}-char docs, mixed marks, "
         f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}",
         "merge_ops_per_sec": round(total_ops / merge_s, 1),
