@@ -30,14 +30,13 @@ def test_reference_trace_replays_convergently(path):
     assert isinstance(spans, list)
 
 
-@pytest.mark.parametrize("name", ["links-minimal.json", "links-brief.json"])
-def test_reference_trace_replays_on_device_engine(name):
-    """The device engine ingests the reference's raw change-log traces and
-    lands on exactly the oracle's state (a CI-sized subset; the full set
-    replays through the oracle above)."""
+@pytest.mark.parametrize("path", TRACES, ids=[os.path.basename(p) for p in TRACES])
+def test_reference_trace_replays_on_device_engine(path):
+    """The device engine ingests every one of the reference's raw
+    change-log failure traces and lands on exactly the oracle's state."""
     from peritext_tpu.ops import TpuDoc
 
-    queues = load_trace(os.path.join(TRACE_DIR, name))["queues"]
+    queues = load_trace(path)["queues"]
     oracle_spans = assert_replay_converges(queues)
     engine_spans = assert_replay_converges(queues, doc_factory=TpuDoc)
     assert engine_spans == oracle_spans
