@@ -256,6 +256,19 @@ class TpuUniverse:
         self.mark_counts = [self.mark_counts[i] for i in keep]
         self.roots = [self.roots[i] for i in keep]
 
+    def shard(self, mesh, shard_seq: bool = True) -> None:
+        """Lay the fleet's device state out over a (replica, seq) mesh.
+
+        Ingestion keeps working unchanged — the jitted merge partitions
+        over the mesh (GSPMD inserts the collectives), and every readback
+        path (spans/texts/digests/cursors) gathers transparently.  Call
+        after construction or any elasticity change; replica count must
+        divide the mesh's replica axis.
+        """
+        from peritext_tpu.parallel import shard_states
+
+        self.states = shard_states(self.states, mesh, shard_seq=shard_seq)
+
     # -- capacity management ------------------------------------------------
 
     def _ensure_capacity(self, need_len: int, need_marks: int) -> None:

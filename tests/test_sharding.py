@@ -240,7 +240,7 @@ def test_elastic_add_replicas_on_sharded_fleet():
     uni = TpuUniverse(names)
     uni.apply_changes({n: [genesis] for n in names})
     mesh = make_mesh(jax.devices()[:8], 8, 1)
-    uni.states = shard_states(uni.states, mesh, shard_seq=False)
+    uni.shard(mesh, shard_seq=False)
 
     uni.add_replicas(["late0", "late1"])
     c, _ = doc1.change(
