@@ -50,6 +50,19 @@ def main() -> None:
     uni.apply_changes({name: [workload["genesis"]] for name in names})
     print(f"genesis: {replicas} replicas bootstrapped in {time.perf_counter()-t0:.2f}s")
 
+    # With more than one device the fleet lays out over a (replica, seq)
+    # mesh — run with XLA_FLAGS=--xla_force_host_platform_device_count=8
+    # (or on a real slice) to see the sharded path.
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev > 1 and replicas % n_dev == 0:
+        from peritext_tpu.parallel import make_mesh
+
+        mesh = make_mesh(jax.devices(), n_dev, 1)
+        uni.shard(mesh, shard_seq=False)
+        print(f"fleet sharded over mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
     total_ops = 0
     wall = 0.0
     # The host/device split reports the measured rounds only, so exclude
