@@ -53,16 +53,19 @@ def attempt(platform: str | None, timeout: float) -> str | None:
 def main() -> None:
     # The default-platform attempt hits the TPU tunnel, which can wedge and
     # hang at device init; give it its own (overridable) budget so a wedged
-    # tunnel can't eat the CPU fallback's time.
+    # tunnel can't eat the CPU fallback's time.  The budget covers several
+    # fresh XLA compiles (merge + latency shapes + a possible scan-path
+    # retry), so it errs generous — killing a healthy run mid-compile would
+    # lose the hardware number entirely.
     line = attempt(
         None,
         timeout=float(
-            os.environ.get("BENCH_TPU_TIMEOUT", os.environ.get("BENCH_TIMEOUT", "900"))
+            os.environ.get("BENCH_TPU_TIMEOUT", os.environ.get("BENCH_TIMEOUT", "1500"))
         ),
     )
     if line is None:
         # TPU tunnel unreachable or run failed: measure on CPU instead.
-        line = attempt("cpu", timeout=float(os.environ.get("BENCH_TIMEOUT", "900")))
+        line = attempt("cpu", timeout=float(os.environ.get("BENCH_TIMEOUT", "1500")))
     if line is None:
         print(
             '{"metric": "merged_crdt_ops_per_sec_batched_replicas", '
