@@ -64,6 +64,30 @@ def _var_roll(x, amt, nbits: int):
     return out
 
 
+def _pad_lanes_128(x):
+    """Pad the lane (last) axis up to a multiple of 128: hardware dynamic
+    rotates reject unaligned widths ("unsupported unaligned shape")."""
+    w = x.shape[-1]
+    pad = (-w) % 128
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    return x
+
+
+def _extract_op_row(opsv, l):
+    """Bring op ``l``'s row to lane 0 of the [B, width] ops plane.
+
+    Mosaic can't prove lane alignment for a dynamic column slice, but it
+    lowers dynamic rotates, after which the per-field extracts are static
+    slices.  Hardware rotates are only correct for amounts in [0, width) —
+    negative amounts silently wrap wrong (verified on the chip), hence the
+    positive-modulo amount.  ``width`` must be a multiple of 128
+    (_pad_lanes_128): unaligned dynamic rotates are rejected by Mosaic.
+    """
+    width = opsv.shape[1]
+    return pltpu.roll(opsv, lax.rem(width - l * OPF, width), 1)
+
+
 def _text_kernel(ops_ref, cb_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_in,
                  ec, ea, er, dl, ch, oi, ln, *, num_ops: int, w2: int):
     b, c = ec_in.shape
@@ -77,10 +101,13 @@ def _text_kernel(ops_ref, cb_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_i
     pos = lax.broadcasted_iota(jnp.int32, (b, c), 1)
     k_bits = K.MAX_RUN_LEN.bit_length()  # run length <= MAX_RUN_LEN
     w2_bits = w2.bit_length() - 1  # w2 is a power of two
+    opsv = ops_ref[:]
 
     def body(l, _):
+        op_row = _extract_op_row(opsv, l)
+
         def col(f):
-            return ops_ref[:, pl.ds(l * OPF + f, 1)]  # [B, 1]
+            return op_row[:, f : f + 1]  # [B, 1]
 
         kind = col(K.K_KIND)
         ctr = col(K.K_CTR)
@@ -206,10 +233,13 @@ def text_phase_pallas(
         ],
         axis=2,
     ).reshape(r, num_ops * OPF)
+    ops_ext = _pad_lanes_128(ops_ext)
 
     b = REPLICA_BLOCK
     row_spec = pl.BlockSpec((b, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    ops_spec = pl.BlockSpec((b, num_ops * OPF), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    ops_spec = pl.BlockSpec(
+        (b, ops_ext.shape[1]), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
     cb_spec = pl.BlockSpec((b, w2), lambda i: (i, 0), memory_space=pltpu.VMEM)
     len_spec = pl.BlockSpec((b, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
     shape = jax.ShapeDtypeStruct((r, c), jnp.int32)
@@ -247,12 +277,16 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
     carried here — the host appends them (they are tiny and independent of
     slot state); only mark_count is tracked for bit allocation.
 
-    NOTE: validated in interpret mode only; never compiled under Mosaic on
-    hardware (the tunnel was down in rounds 1-2).  The lane-expansion and
-    per-word-block reductions were rewritten as static 2D select/max loops
-    (carry_row / expand_rows) to avoid 3D broadcast+reshape, but those loops
-    are equally unverified — compile + re-run the differential tests with
-    interpret=False before enabling this path in the benchmark.
+    Mosaic status: compiles for v5e via the relay AND via the local AOT
+    path (scripts/aot_compile_check.py — run that after any kernel change;
+    it needs no relay).  Hardware-numerics constraints already baked in:
+    masks are int32 bitcasts (no unsigned ops in Mosaic), carry rows use
+    exact single-lane masked sums (no unsigned max), op extraction uses a
+    positive-modulo dynamic rotate over a 128-multiple lane width (negative
+    or unaligned rotates miscompute/reject on the chip).  The text kernel
+    passed the full hardware differential suite; re-run
+    PERITEXT_TEST_PLATFORM=axon pytest tests/test_pallas.py when the relay
+    serves to finish the mark-kernel numerics pass.
 
     Per op (see kernels._apply_mark_fast for the write-class derivation):
     - defined slots inside [s, e): OR in the op bit (own-row carry);
@@ -271,10 +305,13 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
     lane = lax.broadcasted_iota(jnp.int32, (b, w * 2 * c), 1)
     lane_slot = lane % (2 * c)
     lane_word = lane // (2 * c)
+    opsv = ops_ref[:]
 
     def body(l, _):
+        op_row = _extract_op_row(opsv, l)
+
         def col(f):
-            return ops_ref[:, pl.ds(l * OPF + f, 1)]  # [B, 1]
+            return op_row[:, f : f + 1]  # [B, 1]
 
         kind = col(K.K_KIND)
         is_mark = kind == K.KIND_MARK
@@ -303,7 +340,11 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         mkv = mask_out[:]
 
         m = mcount_out[:]  # [B, 1]
-        bit = jnp.uint32(1) << (m % MASK_WORD_BITS).astype(jnp.uint32)
+        # Masks are carried as int32 bitcasts in-kernel: Mosaic implements
+        # neither unsigned reductions nor unsigned shifts.  Bitwise ops are
+        # bit-identical either way; shift-left by up to 31 is the defined
+        # logical shift (bit 31 just reads as the int32 sign bit).
+        bit = jnp.int32(1) << (m % MASK_WORD_BITS)
         word_of_m = m // MASK_WORD_BITS
 
         s_lt_e = s_slot < e_slot
@@ -320,10 +361,14 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
                 keepdims=True,
             )  # [B, 1]
             sel = lane_slot == src  # [B, W*2C]; no lane selected when src=-1
-            vals = jnp.where(sel, mkv, jnp.uint32(0))
+            # At most one lane is selected per word block, so a masked sum
+            # extracts exactly that value (and 0 when src=-1) — unlike max,
+            # it also lowers (no unsigned reductions in Mosaic) and stays
+            # exact for int32-bitcast masks with the top bit set.
+            vals = jnp.where(sel, mkv, 0)
             cols = [
-                jnp.max(
-                    jnp.where(lane_word == j, vals, jnp.uint32(0)),
+                jnp.sum(
+                    jnp.where(lane_word == j, vals, 0),
                     axis=1,
                     keepdims=True,
                 )
@@ -333,7 +378,7 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
 
         row_s = carry_row(s_slot)  # [B, W]
         bit_blocks = jnp.where(
-            jnp.arange(w, dtype=jnp.int32)[None, :] == word_of_m, bit, jnp.uint32(0)
+            jnp.arange(w, dtype=jnp.int32)[None, :] == word_of_m, bit, 0
         )  # [B, W]
         row_s = row_s | bit_blocks
         e_clamped = jnp.minimum(e_slot, 2 * c - 1)
@@ -426,10 +471,15 @@ def mark_phase_pallas(
         raise ValueError(f"replica count {r} must be a multiple of {REPLICA_BLOCK}")
 
     # Word-major flatten: word block w occupies lanes [w*2C, (w+1)*2C).
-    mask_flat = jnp.transpose(bnd_mask, (0, 2, 1)).reshape(r, w_words * two_c)
-    ops_ext = jnp.concatenate(
-        [mark_ops, jnp.zeros((r, num_ops, OPF - K.OP_FIELDS), jnp.int32)], axis=2
-    ).reshape(r, num_ops * OPF)
+    # The kernel carries masks as int32 bitcasts (no unsigned ops in Mosaic).
+    mask_flat = lax.bitcast_convert_type(
+        jnp.transpose(bnd_mask, (0, 2, 1)).reshape(r, w_words * two_c), jnp.int32
+    )
+    ops_ext = _pad_lanes_128(
+        jnp.concatenate(
+            [mark_ops, jnp.zeros((r, num_ops, OPF - K.OP_FIELDS), jnp.int32)], axis=2
+        ).reshape(r, num_ops * OPF)
+    )
 
     b = REPLICA_BLOCK
 
@@ -440,7 +490,7 @@ def mark_phase_pallas(
         functools.partial(_mark_kernel, num_ops=num_ops, c=c, w=w_words),
         grid=(r // b,),
         in_specs=[
-            spec(num_ops * OPF),
+            spec(ops_ext.shape[1]),
             spec(two_c),
             spec(w_words * two_c),
             spec(c),
@@ -451,7 +501,7 @@ def mark_phase_pallas(
         out_specs=[spec(two_c), spec(w_words * two_c), spec(1)],
         out_shape=[
             jax.ShapeDtypeStruct((r, two_c), jnp.int32),
-            jax.ShapeDtypeStruct((r, w_words * two_c), jnp.uint32),
+            jax.ShapeDtypeStruct((r, w_words * two_c), jnp.int32),
             jax.ShapeDtypeStruct((r, 1), jnp.int32),
         ],
         interpret=interpret,
@@ -465,7 +515,12 @@ def mark_phase_pallas(
         mark_count[:, None],
     )
     new_def, new_mask_flat, _ = outs
-    new_mask = jnp.transpose(new_mask_flat.reshape(r, w_words, two_c), (0, 2, 1))
+    new_mask = jnp.transpose(
+        lax.bitcast_convert_type(new_mask_flat, jnp.uint32).reshape(
+            r, w_words, two_c
+        ),
+        (0, 2, 1),
+    )
     return new_def.astype(bool), new_mask
 
 
