@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -681,6 +682,19 @@ merge_step_fused_batch = jax.jit(merge_step_fused_vmapped)
 # tombstones) and apply as one [L, C] masked match per round.
 
 
+# Splice strategy for sort-based placement: "sort" (default) materializes
+# each round's output with a stable sort by destination — scatter-free, since
+# XLA lowers generic scatters near-serially (measured 8.9x whole-bench on
+# CPU; scatters are the known slow path on TPU too).  "scatter" keeps the
+# .at[].set splice for A/B.  Read at import/trace time: set PERITEXT_SPLICE
+# before importing (bench A/B runs set it per subprocess).
+_SPLICE_MODE = os.environ.get("PERITEXT_SPLICE", "sort")
+if _SPLICE_MODE not in ("sort", "scatter"):
+    raise ValueError(
+        f"PERITEXT_SPLICE={_SPLICE_MODE!r}: must be 'sort' or 'scatter'"
+    )
+
+
 def _place_round(carry, r, ops, round_of, ranks, char_buf, maxk: int):
     """Apply every round-r text op simultaneously (one scatter pass)."""
     elem_ctr, elem_act, deleted, chars, orig_idx, length = carry
@@ -742,19 +756,58 @@ def _place_round(carry, r, ops, round_of, ranks, char_buf, maxk: int):
     block_ctr = ctr_i[:, None] + off[None, :]
     block_act = jnp.broadcast_to(ops[:, K_ACT, None], (ops.shape[0], maxk))
 
+    zero_blk = jnp.zeros_like(block_ctr)
+    new_length = length + jnp.sum(k)
+    if _SPLICE_MODE == "sort":
+        # Scatter-free splice: XLA:TPU lowers generic scatters to a
+        # near-serial loop over indices, which dominates the whole merge on
+        # hardware.  Destinations are unique, so materializing the output is
+        # a stable multi-operand sort by destination (fully vectorized
+        # compare-exchange on TPU): concat (existing, op-block) entries,
+        # sort by dest, keep the first C, then mask the beyond-length tail
+        # to the scatter path's fill values.  State-identical to the scatter
+        # splice (same suites cover both; PERITEXT_SPLICE selects).
+        keys = jnp.concatenate([dest_exist, dest_ops.reshape(-1)])
+        planes = [
+            (jnp.concatenate([elem_ctr, block_ctr.reshape(-1)]), 0),
+            (jnp.concatenate([elem_act, block_act.reshape(-1)]), 0),
+            (
+                jnp.concatenate([deleted.astype(jnp.int32), zero_blk.reshape(-1)]),
+                0,
+            ),
+            (jnp.concatenate([chars, block_chars.reshape(-1)]), 0),
+            (jnp.concatenate([orig_idx, zero_blk.reshape(-1) - 1]), -1),
+        ]
+        sorted_ops = lax.sort(
+            [keys] + [p for p, _ in planes], dimension=0, num_keys=1, is_stable=True
+        )
+        live_out = ar < new_length
+        outs = [
+            jnp.where(live_out, vals[:c], fill)
+            for vals, (_, fill) in zip(sorted_ops[1:], planes)
+        ]
+        new_carry = (
+            outs[0],
+            outs[1],
+            outs[2].astype(bool),
+            outs[3],
+            outs[4],
+            new_length,
+        )
+        return new_carry
+
     def scat(exist_vals, op_vals, fill):
         out = jnp.full(c, fill, exist_vals.dtype)
         out = out.at[dest_exist].set(exist_vals, mode="drop")
         return out.at[dest_ops].set(op_vals, mode="drop")
 
-    zero_blk = jnp.zeros_like(block_ctr)
     new_carry = (
         scat(elem_ctr, block_ctr, 0),
         scat(elem_act, block_act, 0),
         scat(deleted.astype(jnp.int32), zero_blk, 0).astype(bool),
         scat(chars, block_chars, 0),
         scat(orig_idx, zero_blk - 1, -1),
-        length + jnp.sum(k),
+        new_length,
     )
     return new_carry
 

@@ -209,3 +209,35 @@ def test_chunked_sorted_merge_matches_unchunked():
         batch["states"], text, ro, nr, mark_ops, ranks, buf, maxk, chunk=3
     )
     assert_states_equal(ref, out, "chunked")
+
+
+def test_scatter_splice_matches_sort_splice(monkeypatch):
+    """Both splice strategies (PERITEXT_SPLICE) produce identical states.
+
+    The module default is "sort"; the scatter branch is the A/B fallback and
+    must not rot.  _SPLICE_MODE is read at trace time, so patching the module
+    global and calling the unjitted merge covers the scatter branch.
+    """
+    workload = make_merge_workload(
+        doc_len=60, ops_per_merge=24, num_streams=3, with_marks=True, seed=11
+    )
+    batch = build_device_batch(workload, num_replicas=3, capacity=128, max_mark_ops=64)
+    text, ro, nr, buf, maxk = sorted_inputs(
+        [np.asarray(batch["text_ops"][r]) for r in range(3)]
+    )
+    mark_ops = jnp.asarray(batch["mark_ops"])
+    ranks = jnp.asarray(batch["ranks"])
+
+    def run():
+        import jax
+
+        return jax.vmap(
+            lambda st, t, r, m, b: K.merge_step_sorted(
+                st, t, r, jnp.int32(nr), m, ranks, b, maxk=maxk
+            )
+        )(batch["states"], text, ro, mark_ops, buf)
+
+    ref = run()  # module default (sort)
+    monkeypatch.setattr(K, "_SPLICE_MODE", "scatter")
+    out = run()
+    assert_states_equal(ref, out, "scatter vs sort splice")
