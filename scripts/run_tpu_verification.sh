@@ -2,15 +2,19 @@
 # One-command TPU verification sweep — run when the TPU relay serves.
 #
 # Produces, in ./tpu_verification/:
+#   sanity.txt            tiny device op (fail-fast if the relay is wedged)
 #   bench_sorted.json     headline bench on the default (TPU) platform
+#   bench_scatter.json    same workload with the scatter splice (A/B)
 #   bench_scan.json       same workload on the sequential scan path
 #   bench_pallas.json     same workload on the VMEM Pallas merge
-#   pallas_hw.txt         Pallas differential tests with interpret=False
+#   bench_r{4096,8192}.json  replica-batch scaling points
+#   pallas_hw_*.txt       Pallas differential tests with interpret=False,
+#                         one file per test so a hang loses one test only
 #   config4.json config5.json   BASELINE configs at hardware scale
 #   profile/              jax.profiler device trace of one bench run
 #
 # Every step is supervised with a timeout so a wedged relay can't hang the
-# sweep; partial results are kept.
+# sweep; partial results are kept.  Steps are ordered most-valuable-first.
 set -u
 cd "$(dirname "$0")/.."
 OUT=tpu_verification
@@ -23,14 +27,31 @@ run() { # name timeout cmd...
     && echo "   ok" || echo "   FAILED (see $OUT/$name.err)"
 }
 
+# Fail fast if the relay is wedged: a 4x4 readback, supervised.
+run sanity.txt 120 python3 -c "
+import numpy as np, jax.numpy as jnp
+print(float(np.asarray(jnp.ones((4,4)).sum())))"
+grep -q 16.0 "$OUT/sanity.txt" || { echo "relay wedged; aborting sweep"; exit 1; }
+
 run bench_sorted.json 1800 python3 bench.py
+run bench_scatter.json 1800 env PERITEXT_SPLICE=scatter python3 bench.py
 run bench_scan.json 1800 env BENCH_PATH=scan python3 bench.py
 run bench_pallas.json 1800 env BENCH_PALLAS=1 python3 bench.py
+run bench_r4096.json 1800 env BENCH_REPLICAS=4096 python3 bench.py
+run bench_r8192.json 2400 env BENCH_REPLICAS=8192 python3 bench.py
 
 # Pallas differential on hardware: conftest pins tests to cpu, so override,
 # and force compiled (non-interpret) kernels via the ambient TPU backend.
-run pallas_hw.txt 1800 env PERITEXT_TEST_PLATFORM=axon \
-  python3 -m pytest tests/test_pallas.py -q
+# One pytest invocation per test id: a mid-suite hang (or relay wedge)
+# costs that one test, not the whole pass.
+PALLAS_TESTS=$(python3 -m pytest tests/test_pallas.py --collect-only -q 2>/dev/null \
+  | grep "::" || true)
+i=0
+for t in $PALLAS_TESTS; do
+  run "pallas_hw_$i.txt" 900 env PERITEXT_TEST_PLATFORM=axon \
+    python3 -m pytest "$t" -q
+  i=$((i + 1))
+done
 
 run config5.json 3600 env \
   CONFIG5_REPLICAS="${CONFIG5_REPLICAS:-100000}" \
