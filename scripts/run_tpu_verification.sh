@@ -27,11 +27,14 @@ run() { # name timeout cmd...
     && echo "   ok" || echo "   FAILED (see $OUT/$name.err)"
 }
 
-# Fail fast if the relay is wedged: a 4x4 readback, supervised.
+# Fail fast if the relay is wedged or absent: a 4x4 readback that must land
+# on the TPU backend (a cpu fallback would silently mislabel the whole
+# sweep's artifacts as hardware numbers).
 run sanity.txt 120 python3 -c "
-import numpy as np, jax.numpy as jnp
-print(float(np.asarray(jnp.ones((4,4)).sum())))"
-grep -q 16.0 "$OUT/sanity.txt" || { echo "relay wedged; aborting sweep"; exit 1; }
+import jax, numpy as np, jax.numpy as jnp
+print(float(np.asarray(jnp.ones((4,4)).sum())), jax.devices()[0].platform)"
+grep -Eq "16.0 (axon|tpu)" "$OUT/sanity.txt" \
+  || { echo "relay wedged or not serving a TPU backend; aborting sweep"; exit 1; }
 
 run bench_sorted.json 1800 python3 bench.py
 run bench_scatter.json 1800 env PERITEXT_SPLICE=scatter python3 bench.py
@@ -44,14 +47,22 @@ run bench_r8192.json 2400 env BENCH_REPLICAS=8192 python3 bench.py
 # and force compiled (non-interpret) kernels via the ambient TPU backend.
 # One pytest invocation per test id: a mid-suite hang (or relay wedge)
 # costs that one test, not the whole pass.
-PALLAS_TESTS=$(python3 -m pytest tests/test_pallas.py --collect-only -q 2>/dev/null \
-  | grep "::" || true)
-i=0
-for t in $PALLAS_TESTS; do
-  run "pallas_hw_$i.txt" 900 env PERITEXT_TEST_PLATFORM=axon \
-    python3 -m pytest "$t" -q
-  i=$((i + 1))
-done
+# Collection runs supervised and pinned to cpu (an inherited
+# PERITEXT_TEST_PLATFORM=axon would otherwise hang collection on a wedged
+# relay); an empty collection is a loud failure, not a silent skip.
+run pallas_collect.txt 300 env PERITEXT_TEST_PLATFORM=cpu \
+  python3 -m pytest tests/test_pallas.py --collect-only -q
+PALLAS_TESTS=$(grep "::" "$OUT/pallas_collect.txt" || true)
+if [ -z "$PALLAS_TESTS" ]; then
+  echo "   FAILED: no Pallas tests collected (see $OUT/pallas_collect.txt)"
+else
+  i=0
+  for t in $PALLAS_TESTS; do
+    run "pallas_hw_$i.txt" 900 env PERITEXT_TEST_PLATFORM=axon \
+      python3 -m pytest "$t" -q
+    i=$((i + 1))
+  done
+fi
 
 run config5.json 3600 env \
   CONFIG5_REPLICAS="${CONFIG5_REPLICAS:-100000}" \

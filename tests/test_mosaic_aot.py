@@ -1,0 +1,34 @@
+"""Opt-in Mosaic AOT compile regression test (PERITEXT_SLOW=1).
+
+scripts/aot_compile_check.py compiles every Pallas kernel for an abstract
+v5e topology through the local libtpu AOT path — no TPU device or relay.
+Runs in a subprocess: the check needs a clean backend (the test process is
+pinned to an 8-device virtual CPU platform by conftest).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PERITEXT_SLOW") != "1",
+    reason="Mosaic AOT compile check is slow; set PERITEXT_SLOW=1",
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_all_pallas_kernels_compile_under_mosaic():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "aot_compile_check.py")],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for name in ("text", "mark", "full"):
+        assert f"mosaic aot compile ok: {name}" in proc.stdout
