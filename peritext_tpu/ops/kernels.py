@@ -689,9 +689,9 @@ merge_step_fused_batch = jax.jit(merge_step_fused_vmapped)
 # .at[].set splice for A/B.  Read at import/trace time: set PERITEXT_SPLICE
 # before importing (bench A/B runs set it per subprocess).
 _SPLICE_MODE = os.environ.get("PERITEXT_SPLICE", "sort")
-if _SPLICE_MODE not in ("sort", "scatter"):
+if _SPLICE_MODE not in ("sort", "scatter", "roll"):
     raise ValueError(
-        f"PERITEXT_SPLICE={_SPLICE_MODE!r}: must be 'sort' or 'scatter'"
+        f"PERITEXT_SPLICE={_SPLICE_MODE!r}: must be 'sort', 'scatter' or 'roll'"
     )
 
 
@@ -758,6 +758,87 @@ def _place_round(carry, r, ops, round_of, ranks, char_buf, maxk: int):
 
     zero_blk = jnp.zeros_like(block_ctr)
     new_length = length + jnp.sum(k)
+    if _SPLICE_MODE == "roll":
+        # Roll splice: move existing elements right by their displacement
+        # with MSB-first binary-decomposed rolls, then overwrite block
+        # positions from small one-hot reductions.  ceil(log2(L*maxk+1))
+        # roll+select passes over [C] planes — cheaper than a bitonic sort
+        # over C+L*maxk lanes, and scatter-free.
+        #
+        # Correctness of the greedy bit decomposition: displacements are
+        # non-decreasing along positions (shifts is a cumulative count), and
+        # MSB-first keeps every alive remainder below the current step's
+        # doubled width; if a mover (rem >= step) could land on an alive
+        # slower value (0 < rem < step), the two remainders mod 2*step
+        # would have to differ by more than the values' destination gap
+        # allows — impossible for monotone displacements (proof by the
+        # mod-2^(b+1) window: rem_X >= 2^b, delta <= 2^b - 2 forces
+        # rem_Y = rem_X + delta mod 2^(b+1) >= 2^b, a contradiction).
+        # Stale source copies are marked DEAD (-1) so they never move again;
+        # every position below the new length is re-covered by a mover, a
+        # block lane, or its unmoved occupant, and the tail is masked to
+        # the scatter fills.
+        rem = shifts  # [C]; beyond-length lanes inherit the running count
+        planes = [
+            elem_ctr,
+            elem_act,
+            deleted.astype(jnp.int32),
+            chars,
+            orig_idx,
+        ]
+        max_disp = ops.shape[0] * maxk
+        for b in reversed(range(max_disp.bit_length())):
+            step = 1 << b
+            moved_rem = jnp.roll(rem, step)
+            sel = (moved_rem >= step) & (ar >= step)  # block wraparound
+            planes = [
+                jnp.where(sel, jnp.roll(p, step), p) for p in planes
+            ]
+            rem = jnp.where(sel, moved_rem - step, jnp.where(rem >= step, -1, rem))
+
+        # Block fill: each output position belongs to at most one op block
+        # (destinations are unique), so masked int max-reductions are exact.
+        in_blk = (
+            is_ins[:, None]
+            & (ar[None, :] >= s[:, None])
+            & (ar[None, :] < (s + k)[:, None])
+        )  # [L, C]
+        neg = jnp.int32(-(2**31) + 1)
+        blk_any = in_blk.any(axis=0)
+
+        def from_ops(vals_l):  # [L] -> [C] masked max over the owning op
+            return jnp.max(jnp.where(in_blk, vals_l[:, None], neg), axis=0)
+
+        blk_ctr = from_ops(ctr_i - s) + ar  # ctr_l + (p - s_l)
+        blk_act = from_ops(ops[:, K_ACT])
+        # Run chars via the [L*maxk] block-lane one-hot (payload chars for
+        # plain inserts ride the same table).
+        lane_hit = (
+            in_block.reshape(-1)[:, None]
+            & (dest_ops.reshape(-1)[:, None] == ar[None, :])
+        )  # [L*maxk, C]
+        blk_char = jnp.max(
+            jnp.where(lane_hit, block_chars.reshape(-1)[:, None], neg), axis=0
+        )
+
+        live_out = ar < new_length
+        outs = [
+            jnp.where(blk_any, blk_ctr, planes[0]),
+            jnp.where(blk_any, blk_act, planes[1]),
+            jnp.where(blk_any, 0, planes[2]),
+            jnp.where(blk_any, blk_char, planes[3]),
+            jnp.where(blk_any, -1, planes[4]),
+        ]
+        fills = (0, 0, 0, 0, -1)
+        outs = [jnp.where(live_out, o, f) for o, f in zip(outs, fills)]
+        return (
+            outs[0],
+            outs[1],
+            outs[2].astype(bool),
+            outs[3],
+            outs[4],
+            new_length,
+        )
     if _SPLICE_MODE == "sort":
         # Scatter-free splice: XLA:TPU lowers generic scatters to a
         # near-serial loop over indices, which dominates the whole merge on

@@ -38,6 +38,7 @@ grep -Eq "16.0 (axon|tpu)" "$OUT/sanity.txt" \
 
 run bench_sorted.json 1800 python3 bench.py
 run bench_scatter.json 1800 env PERITEXT_SPLICE=scatter python3 bench.py
+run bench_roll.json 1800 env PERITEXT_SPLICE=roll python3 bench.py
 run bench_scan.json 1800 env BENCH_PATH=scan python3 bench.py
 run bench_pallas.json 1800 env BENCH_PALLAS=1 python3 bench.py
 run bench_r4096.json 1800 env BENCH_REPLICAS=4096 python3 bench.py

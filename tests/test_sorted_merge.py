@@ -238,6 +238,7 @@ def test_scatter_splice_matches_sort_splice(monkeypatch):
         )(batch["states"], text, ro, mark_ops, buf)
 
     ref = run()  # module default (sort)
-    monkeypatch.setattr(K, "_SPLICE_MODE", "scatter")
-    out = run()
-    assert_states_equal(ref, out, "scatter vs sort splice")
+    for mode in ("scatter", "roll"):
+        monkeypatch.setattr(K, "_SPLICE_MODE", mode)
+        out = run()
+        assert_states_equal(ref, out, f"{mode} vs default splice")
