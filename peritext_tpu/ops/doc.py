@@ -29,7 +29,14 @@ import numpy as np
 from peritext_tpu.ids import make_op_id
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.state import index_state, stack_states
-from peritext_tpu.ops.universe import TpuUniverse, apply_root_op, assemble_patches
+from peritext_tpu.ops.universe import TpuUniverse, assemble_patches
+from peritext_tpu.oracle.doc import (
+    ROOT,
+    generate_input_op,
+    get_list_element_id,
+    get_text_with_formatting as oracle_spans,
+    op_to_wire,
+)
 from peritext_tpu.schema import MARK_SPEC, MARK_TYPE_ID, allow_multiple_array
 
 Change = Dict[str, Any]
@@ -52,25 +59,51 @@ class TpuDoc:
 
     @property
     def root(self) -> Dict[str, Any]:
-        """Root view; ``root["text"]`` materializes the visible characters."""
-        root = dict(self._uni.roots[0])
-        if self._text_obj() is not None:
+        """Root view; ``root["text"]`` materializes the visible characters
+        when the text key resolves to the device-resident list.  Other keys
+        (plain values, nested maps, host-side lists) come straight from the
+        host object store (oracle semantics)."""
+        store = self._store
+        root = dict(store.objects[ROOT])
+        children = store.metadata[ROOT].children
+        text_obj = self._text_obj()
+        if text_obj is not None and children.get("text") == text_obj:
             root["text"] = list(self._uni.text(0))
         return root
 
     def get_text_with_formatting(self, path: Sequence[str]) -> List[Dict[str, Any]]:
-        if list(path) != ["text"]:
-            raise KeyError(f"No list at path {path!r}")
-        return self._uni.spans(0)
+        obj_id = self._store.get_object_id_for_path(path)
+        if obj_id == self._text_obj() and obj_id is not None:
+            return self._uni.spans(0)
+        text = self._store.objects.get(obj_id)
+        meta = self._store.metadata.get(obj_id)
+        if not isinstance(text, list) or not isinstance(meta, list):
+            raise TypeError(f"Expected a list at object ID {obj_id}")
+        return oracle_spans(text, meta, self._store.mark_ops)
 
     def get_cursor(self, path: Sequence[str], index: int) -> Dict[str, Any]:
-        return self._uni.get_cursor(0, index)
+        obj_id = self._store.get_object_id_for_path(path)
+        if obj_id == self._text_obj() and obj_id is not None:
+            return self._uni.get_cursor(0, index)
+        meta = self._store.metadata.get(obj_id)
+        if not isinstance(meta, list):
+            raise TypeError(f"Expected a list at object ID {obj_id}")
+        return {"objectId": obj_id, "elemId": get_list_element_id(meta, index)}
 
     def resolve_cursor(self, cursor: Dict[str, Any]) -> int:
-        return self._uni.resolve_cursor(0, cursor)
+        if cursor.get("objectId") == self._text_obj() and cursor.get("objectId") is not None:
+            return self._uni.resolve_cursor(0, cursor)
+        _, visible = self._store.find_list_element(
+            cursor["objectId"], cursor["elemId"]
+        )
+        return visible
+
+    @property
+    def _store(self):
+        return self._uni.stores[0]
 
     def _text_obj(self) -> Optional[str]:
-        return self._uni.roots[0].get("__lists__", {}).get("text")
+        return self._uni.text_objs[0]
 
     def _state(self):
         return index_state(self._uni.states, 0)
@@ -125,11 +158,14 @@ class TpuDoc:
         action = input_op["action"]
         path = list(input_op["path"])
 
-        if not path:  # root-map structural ops
-            return self._generate_root_op(change, input_op)
-        if path != ["text"] or self._text_obj() is None:
-            raise KeyError(f"No list at path {path!r}")
-        obj = self._text_obj()
+        obj = self._store.get_object_id_for_path(path)
+        if obj is None or obj != self._text_obj():
+            # Root/nested maps and host-side lists: the oracle's generation
+            # logic against the host store (shared generate_input_op, so the
+            # two engines cannot diverge on generation semantics).
+            return generate_input_op(
+                self._store, input_op, lambda op: self._make_host_op(change, op)
+            )
 
         rows: List[np.ndarray] = []
         if action == "insert":
@@ -249,23 +285,29 @@ class TpuDoc:
             wire["attrs"] = dict(input_op["attrs"])
         return row, wire
 
-    def _generate_root_op(self, change: Change, input_op: Dict[str, Any]) -> List[Patch]:
-        action = input_op["action"]
+    def _make_host_op(self, change: Change, op: Dict[str, Any]) -> Tuple[str, List[Patch]]:
+        """Allocate an op id, apply to the host store, record the wire form
+        (the host-side half of the reference's makeNewOp, micromerge.ts:483-493)."""
         self.max_op += 1
         op_id = make_op_id(self.max_op, self.actor_id)
-        key = input_op["key"]
-        wire: Dict[str, Any] = {"opId": op_id, "action": action, "key": key}
-        if action == "set":
-            wire["value"] = input_op["value"]
-        if action not in ("makeList", "makeMap", "set", "del"):
-            raise NotImplementedError(action)
-        change["ops"].append(wire)
-        took_effect = apply_root_op(self._uni.roots[0], wire)
-        if action == "makeList" and took_effect:
-            # Reference emits a makeList patch with hardcoded path
-            # (micromerge.ts:592).
-            return [{**wire, "path": ["text"]}]
-        return []
+        op_with_id = {"opId": op_id, **op}
+        patches = self._store.apply_op(op_with_id)
+        # In-place store mutation: move this replica to a fresh version
+        # class (single-replica universe, so nothing aliases, but the
+        # equal-version ⟹ equal-store invariant must hold regardless).
+        self._uni._store_version_counter += 1
+        self._uni.store_versions[0] = self._uni._store_version_counter
+        change["ops"].append(op_to_wire(op_with_id))
+        if (
+            op["action"] == "makeList"
+            and op.get("obj") is None
+            and op.get("key") == "text"
+            and self._uni.text_objs[0] is None
+        ):
+            # First root text list: bind the device data plane to it.
+            self._uni.text_objs[0] = op_id
+            self._store.device_objects.add(op_id)
+        return op_id, patches
 
     def _apply_rows(self, rows: List[np.ndarray]) -> List[Patch]:
         if not rows:

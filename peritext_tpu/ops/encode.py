@@ -189,44 +189,60 @@ def encode_changes(
 ) -> Tuple[np.ndarray, List[Dict[str, Any]], Dict[str, int]]:
     """Flatten a causally-ordered change batch into device op rows.
 
-    Returns (rows [N, OP_FIELDS], host_ops, counts) where host_ops are the
-    structural ops skipped for host handling and counts tallies inserts and
-    mark ops for capacity pre-checks.
+    Returns (rows [N, OP_FIELDS], host_ops, counts) where host_ops is a list
+    of ``(pos, op)`` pairs — structural/nested-object ops routed to the host
+    object store, tagged with their flat position in the batch's op stream so
+    the patch path can interleave host and device patches in true op order —
+    and counts tallies device inserts and mark ops for capacity pre-checks
+    (plus ``row_pos``, the flat positions of the device rows, and
+    ``text_obj``, the device text-list binding after this batch).
 
-    ``text_obj`` is the replica's established root text-list id (None before
-    genesis).  Every device-bound op must target that list — the engine's
-    data plane is the single text list, and an op addressing any other
-    object (a second makeList, a nested list) raises loudly here instead of
-    being silently spliced into the text document (the reference dispatches
-    per-object, micromerge.ts:534-608; this engine deliberately does not).
+    Ops route by target object, mirroring the reference's per-object dispatch
+    (micromerge.ts:534-608): ops on the device text list become op rows;
+    everything else — map ops, nested lists, second lists — goes host-side.
+    The first root ``makeList`` with key "text" establishes the device
+    binding; an op targeting an object the host store doesn't know raises
+    there rather than being silently spliced into the text document.
+
+    ``text_obj`` is the replica's established device text-list id (None
+    before genesis).
     """
     rows: List[np.ndarray] = []
-    host_ops: List[Dict[str, Any]] = []
-    counts = {"insert": 0, "mark": 0}
+    row_pos: List[int] = []
+    host_ops: List[Tuple[int, Dict[str, Any]]] = []
+    counts: Dict[str, Any] = {"insert": 0, "mark": 0}
+    pos = 0
     for change in changes:
         for op in change["ops"]:
-            row = encode_internal_op(op, actors, attrs)
-            if row is None:
+            obj = op.get("obj")
+            if obj != text_obj or text_obj is None:
+                # Structural op (map makeList/makeMap/set/del), or a list op
+                # on a host-side (non-device) list: the host store applies
+                # it.  Route before encoding — host lists may hold values the
+                # device char plane can't (and must not) encode.
                 if op["action"] == "makeList" and op.get("key") == "text" and text_obj is None:
                     text_obj = op["opId"]
-                host_ops.append(op)
-                continue
-            obj = op.get("obj")
-            if obj != text_obj:
-                raise ValueError(
-                    f"op {op.get('opId')!r} targets object {obj!r}, but this "
-                    f"engine's device data plane is the root text list "
-                    f"({text_obj!r}); non-text list objects are host-side only"
-                )
-            if row[K.K_KIND] == K.KIND_INSERT:
-                counts["insert"] += 1
-            elif row[K.K_KIND] == K.KIND_MARK:
-                counts["mark"] += 1
-            rows.append(row)
+                host_ops.append((pos, op))
+            else:
+                row = encode_internal_op(op, actors, attrs)
+                if row is None:
+                    raise ValueError(
+                        f"op {op.get('opId')!r} is a map op targeting the "
+                        f"device text list {text_obj!r}"
+                    )
+                if row[K.K_KIND] == K.KIND_INSERT:
+                    counts["insert"] += 1
+                elif row[K.K_KIND] == K.KIND_MARK:
+                    counts["mark"] += 1
+                rows.append(row)
+                row_pos.append(pos)
+            pos += 1
     if rows:
         out = np.stack(rows)
     else:
         out = np.zeros((0, K.OP_FIELDS), np.int32)
+    counts["row_pos"] = np.asarray(row_pos, np.int64)
+    counts["text_obj"] = text_obj
     return out, host_ops, counts
 
 

@@ -15,6 +15,7 @@ algebra, mark resolution, digests.
 """
 from __future__ import annotations
 
+import copy
 import hashlib
 import json
 import math
@@ -42,7 +43,13 @@ from peritext_tpu.ops.state import (
     make_empty_state,
     stack_states,
 )
-from peritext_tpu.oracle.doc import ops_to_marks
+from peritext_tpu.oracle.doc import (
+    ObjectStore,
+    get_list_element_id,
+    get_text_with_formatting as oracle_spans,
+    op_from_wire,
+    ops_to_marks,
+)
 from peritext_tpu.runtime.sync import causal_order
 from peritext_tpu import schema
 from peritext_tpu.schema import allow_multiple_array
@@ -50,28 +57,16 @@ from peritext_tpu.schema import allow_multiple_array
 Change = Dict[str, Any]
 
 
-def apply_root_op(root: Dict[str, Any], op: Dict[str, Any]) -> bool:
-    """Apply one structural op to a host root map with LWW by op id
-    (the oracle's map-key rule, micromerge.ts:578-602).  Returns whether the
-    op took effect."""
-    from peritext_tpu.ids import compare_op_ids
+def apply_host_op(store: ObjectStore, op: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Apply one wire-format structural/host-object op to a replica's host
+    object store (the oracle's per-object dispatch, micromerge.ts:534-608).
+    Returns the emitted patches.
 
-    action = op["action"]
-    key = op.get("key")
-    key_ops = root.setdefault("__key_ops__", {})
-    stored = key_ops.get(key)
-    if stored is not None and compare_op_ids(stored, op["opId"]) != -1:
-        return False
-    key_ops[key] = op["opId"]
-    if action == "makeList":
-        root.setdefault("__lists__", {})[key] = op["opId"]
-    elif action == "makeMap":
-        root.setdefault("__maps__", {})[key] = op["opId"]
-    elif action == "set":
-        root[key] = op.get("value")
-    elif action == "del":
-        root.pop(key, None)
-    return True
+    The device engine's data plane is the root text list; every other object
+    — the root map, nested maps, second lists, comment tables — lives in the
+    host :class:`ObjectStore`, which shares the oracle's exact semantics
+    (map-key LWW, RGA list inserts, mark walks)."""
+    return store.apply_op(op_from_wire(op))
 
 
 def assemble_patches(
@@ -80,9 +75,18 @@ def assemble_patches(
     op_rows: np.ndarray,
     table: Dict[str, Dict[str, Any]],
     attrs: AttrRegistry,
+    row_pos: Optional[np.ndarray] = None,
 ) -> List[Dict[str, Any]]:
-    """Reference-format patches from per-op device records (one replica)."""
-    patches: List[Dict[str, Any]] = []
+    """Reference-format patches from per-op device records (one replica).
+
+    With ``row_pos`` (the flat batch-stream position of each op row, from
+    encode_changes), returns ``(pos, patch)`` pairs instead, so the caller
+    can interleave device patches with host-object patches in op order."""
+    patches: List[Any] = []
+
+    def emit(i: int, patch: Dict[str, Any]) -> None:
+        patches.append(patch if row_pos is None else (int(row_pos[i]), patch))
+
     op_ids = list(table)
 
     def decode_mask(row: np.ndarray) -> Dict[str, Any]:
@@ -97,26 +101,29 @@ def assemble_patches(
         if kind == K.KIND_PAD or not records["valid"][r, i]:
             continue
         if kind == K.KIND_INSERT:
-            patches.append(
+            emit(
+                i,
                 {
                     "path": ["text"],
                     "action": "insert",
                     "index": int(records["index"][r, i]),
                     "values": [chr(int(records["char"][r, i]))],
                     "marks": decode_mask(records["ins_mask"][r, i]),
-                }
+                },
             )
         elif kind == K.KIND_DELETE:
-            patches.append(
+            emit(
+                i,
                 {
                     "path": ["text"],
                     "action": "delete",
                     "index": int(records["index"][r, i]),
                     "count": 1,
-                }
+                },
             )
         elif kind == K.KIND_MARK:
-            patches.extend(assemble_mark_patches(records, r, i, op_rows[i], attrs))
+            for patch in assemble_mark_patches(records, r, i, op_rows[i], attrs):
+                emit(i, patch)
     return patches
 
 
@@ -184,7 +191,18 @@ class TpuUniverse:
         self.clocks: List[Dict[str, int]] = [dict() for _ in self.replica_ids]
         self.lengths = [0] * len(self.replica_ids)
         self.mark_counts = [0] * len(self.replica_ids)
-        self.roots: List[Dict[str, Any]] = [dict() for _ in self.replica_ids]
+        # Host structural plane: per-replica object store (root map, nested
+        # maps/lists — everything but the device text list) + the permanent
+        # device binding (the first root makeList with key "text").
+        # Replicas with equal ``store_versions`` hold equal stores and may
+        # SHARE one ObjectStore instance (the converged-fleet fast path:
+        # one deepcopy+apply per version class instead of per replica), so
+        # stores must only ever be replaced via the _prepare copy-swap,
+        # never mutated in place (TpuDoc's local path bumps its version).
+        self.stores: List[ObjectStore] = [ObjectStore() for _ in self.replica_ids]
+        self.store_versions: List[int] = [0] * len(self.replica_ids)
+        self._store_version_counter = 0
+        self.text_objs: List[Optional[str]] = [None] * len(self.replica_ids)
         # Lightweight observability counters (the reference's observability
         # is console logging + the demo op panel, SURVEY §5; at batch scale
         # these are what perf debugging needs).
@@ -235,7 +253,11 @@ class TpuUniverse:
             self.clocks.append({})
             self.lengths.append(0)
             self.mark_counts.append(0)
-            self.roots.append({})
+            self.stores.append(ObjectStore())
+            # Version 0 always means "untouched empty store", so fresh
+            # replicas may share a version class with untouched founders.
+            self.store_versions.append(0)
+            self.text_objs.append(None)
 
     def drop_replicas(self, names: Sequence[str]) -> None:
         """Shrink the fleet (one gather; dropped replicas' state is gone —
@@ -254,7 +276,9 @@ class TpuUniverse:
         self.clocks = [self.clocks[i] for i in keep]
         self.lengths = [self.lengths[i] for i in keep]
         self.mark_counts = [self.mark_counts[i] for i in keep]
-        self.roots = [self.roots[i] for i in keep]
+        self.stores = [self.stores[i] for i in keep]
+        self.store_versions = [self.store_versions[i] for i in keep]
+        self.text_objs = [self.text_objs[i] for i in keep]
 
     def shard(self, mesh, shard_seq: bool = True) -> None:
         """Lay the fleet's device state out over a (replica, seq) mesh.
@@ -365,7 +389,7 @@ class TpuUniverse:
 
         for r, changes in enumerate(batches):
             clock = self.clocks[r]
-            text_obj = self.roots[r].get("__lists__", {}).get("text")
+            text_obj = self.text_objs[r]
             key = (
                 tuple(change_digest(c) for c in changes),
                 tuple(sorted(clock.items())),
@@ -387,12 +411,46 @@ class TpuUniverse:
                         "dupes": dupes,
                         "rows": rows,
                         "host_ops": host_ops,
+                        "row_pos": counts["row_pos"],
+                        "text_obj": counts["text_obj"],
                         "inserts": counts["insert"],
                         "marks": counts["mark"],
                     }
                 )
             n_ingested += len(groups[gi]["ordered"])
             group_of[r] = gi
+
+        # Host structural ops dry-run against store *copies* (the oracle's
+        # per-object dispatch; host objects are tiny by design — the text
+        # data plane is on device).  A bad op (unknown object, dangling
+        # element) raises here, before anything commits; _commit later swaps
+        # the copies in, preserving the all-or-nothing contract.
+        # One deepcopy+apply runs per (group, store-version) class, not per
+        # replica: a converged fleet ingesting a shared stream (the common
+        # case — genesis at R=100k) pays for ONE application however many
+        # replicas share it; the resulting store instance is shared and a
+        # fresh version allocated per class keeps the equality invariant.
+        new_stores: Dict[int, ObjectStore] = {}
+        new_versions: Dict[int, int] = {}
+        host_patches: Dict[int, List[Any]] = {}
+        by_class: Dict[Any, Any] = {}
+        for r in range(n):
+            g = groups[group_of[r]]
+            if not g["host_ops"]:
+                continue
+            key = (group_of[r], self.store_versions[r])
+            hit = by_class.get(key)
+            if hit is None:
+                store = copy.deepcopy(self.stores[r])
+                emitted: List[Any] = []
+                for pos, op in g["host_ops"]:
+                    emitted.extend((pos, p) for p in apply_host_op(store, op))
+                if g["text_obj"] is not None:
+                    store.device_objects.add(g["text_obj"])
+                self._store_version_counter += 1
+                hit = by_class[key] = (store, self._store_version_counter, emitted)
+            new_stores[r], new_versions[r], host_patches[r] = hit
+
         ins = np.asarray([g["inserts"] for g in groups], np.int64)[group_of]
         mks = np.asarray([g["marks"] for g in groups], np.int64)[group_of]
         lengths = np.asarray(self.lengths, np.int64) + ins
@@ -400,6 +458,9 @@ class TpuUniverse:
         return {
             "groups": groups,
             "group_of": group_of,
+            "new_stores": new_stores,
+            "new_store_versions": new_versions,
+            "host_patches": host_patches,
             "new_lengths": lengths,
             "new_mark_counts": mark_counts,
             "ingested": n_ingested,
@@ -426,8 +487,14 @@ class TpuUniverse:
                 # Each replica owns its clock dict (sharing one dict across a
                 # group would alias later per-replica clock mutations).
                 self.clocks[r] = dict(g["clock"])
-            if g["host_ops"]:
-                self._apply_host_ops(r, g["host_ops"])
+            if r in prep["new_stores"]:
+                # Host structural ops were pre-applied to a store copy in
+                # _prepare; publishing is a pointer swap (shared across the
+                # replica's version class).
+                self.stores[r] = prep["new_stores"][r]
+                self.store_versions[r] = prep["new_store_versions"][r]
+                if g["text_obj"] is not None:
+                    self.text_objs[r] = g["text_obj"]
         self.stats["changes_ingested"] += prep["ingested"]
         sizes = np.bincount(group_of, minlength=len(groups))
         dupes = np.asarray([g["dupes"] for g in groups], np.int64)
@@ -549,19 +616,6 @@ class TpuUniverse:
         self._commit(prep)
         self.stats["host_seconds"] += time.perf_counter() - t_host
 
-    def _apply_host_ops(self, r: int, host_ops: List[Dict[str, Any]]) -> None:
-        """Structural map ops (makeList/makeMap/set/del on the root map).
-
-        The device data plane is the text list; the tiny root-map control
-        plane lives here, with the oracle's last-writer-wins-by-op-id rule
-        (micromerge.ts:578-602) so concurrent root-key writes converge.
-        Only the conventional single text list is supported as a list target
-        (reference demos/tests only ever create root.text, bridge.ts:24-27).
-        """
-        root = self.roots[r]
-        for op in host_ops:
-            apply_root_op(root, op)
-
     # -- patch-emitting ingestion (the incremental codepath) ----------------
 
     def apply_changes_with_patches(
@@ -574,23 +628,28 @@ class TpuUniverse:
         prep = self._prepare(batches)
         groups, group_of = prep["groups"], prep["group_of"]
 
-        for g in groups:
-            g["makelist"] = [
-                {**op, "path": ["text"]}
-                for op in g["host_ops"]
-                if op["action"] == "makeList"
-            ]
         group_sizes, row_counts = self._account_rows(groups, group_of)
         max_rows = int(row_counts.max(initial=0))
 
         self._ensure_capacity(prep["need_len"], prep["need_marks"])
-        out: Dict[str, List[Dict[str, Any]]] = {
-            name: list(groups[group_of[r]]["makelist"])
-            for r, name in enumerate(self.replica_ids)
-        }
+        # Host-object patches (root/nested-map and host-list ops) were
+        # emitted during the _prepare dry-run, tagged with each op's flat
+        # position in the batch stream; device patches get the same tags so
+        # the merged stream is in true op order (what an incremental oracle
+        # consuming this delivery order would emit).
+        # Host patch lists are shared across a version class; hand each
+        # replica its own deep copy so callers can't alias mutations.
+        def host_patches_for(r: int) -> List[Any]:
+            return [
+                (pos, copy.deepcopy(p)) for pos, p in prep["host_patches"].get(r, [])
+            ]
+
         if max_rows == 0:
             self._commit(prep)
-            return out
+            return {
+                name: [p for _, p in sorted(host_patches_for(r), key=lambda t: t[0])]
+                for r, name in enumerate(self.replica_ids)
+            }
         pad = bucket_length(max_rows)
         g_ops = np.stack([pad_rows(g["rows"], pad) for g in groups])
         ops = g_ops[group_of]
@@ -642,11 +701,15 @@ class TpuUniverse:
             raise
         self._commit(prep)
         tables = self._batch_mark_op_table()
+        out: Dict[str, List[Dict[str, Any]]] = {}
         for r, name in enumerate(self.replica_ids):
             rec = record_chunks[r // chunk]
-            out[name].extend(
-                assemble_patches(rec, r % chunk, ops[r], tables[r], self.attrs)
+            g = groups[group_of[r]]
+            dev = assemble_patches(
+                rec, r % chunk, ops[r], tables[r], self.attrs, row_pos=g["row_pos"]
             )
+            merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
+            out[name] = [p for _, p in merged]
         return out
 
     # -- materialization ----------------------------------------------------
@@ -775,6 +838,25 @@ class TpuUniverse:
                 spans.append({"marks": dict(marks), "text": text})
         return spans
 
+    def _text_source(self, r: int) -> Optional[str]:
+        """Which list object ``root.text`` currently resolves to.
+
+        Returns None when that is the device-bound list (the overwhelmingly
+        common case) or the winning object id when map-key LWW
+        (micromerge.ts:578-602) elected a *different* root "text" list than
+        the one the device plane bound to.  The device binding is permanent
+        and first-wins per replica, so with concurrent genesis makeLists two
+        replicas can bind different lists — both still hold every list's
+        content (ops route by object id; the non-bound list lives in the
+        host store), and every view resolves through LWW, so they converge
+        exactly like the oracle.  Note the *digest* compares device states
+        only and can false-alarm in this adversarial double-genesis case.
+        """
+        winner = self.stores[r].metadata[None].children.get("text")
+        if winner is None or winner == self.text_objs[r]:
+            return None
+        return winner
+
     def spans(self, replica: str | int) -> List[Dict[str, Any]]:
         """Materialize one replica as formatted spans (the batch codepath).
 
@@ -784,6 +866,12 @@ class TpuUniverse:
         construction.
         """
         r = replica if isinstance(replica, int) else self.index_of[replica]
+        host = self._text_source(r)
+        if host is not None:
+            store = self.stores[r]
+            return oracle_spans(
+                store.objects[host], store.metadata[host], store.mark_ops
+            )
         state = index_state(self.states, r)
         mask, has = K.flatten_sources_jit(state)
         n = int(state.length)
@@ -812,6 +900,15 @@ class TpuUniverse:
         mark_cache: Dict[Any, Dict[str, Any]] = {}
         out = []
         for r in range(len(self.replica_ids)):
+            host = self._text_source(r)
+            if host is not None:
+                store = self.stores[r]
+                out.append(
+                    oracle_spans(
+                        store.objects[host], store.metadata[host], store.mark_ops
+                    )
+                )
+                continue
             n = int(lengths[r])
             out.append(
                 self._spans_from_arrays(
@@ -827,6 +924,9 @@ class TpuUniverse:
 
     def text(self, replica: str | int) -> str:
         r = replica if isinstance(replica, int) else self.index_of[replica]
+        host = self._text_source(r)
+        if host is not None:
+            return "".join(self.stores[r].objects[host])
         state = index_state(self.states, r)
         n = int(state.length)
         chars = np.asarray(state.chars[:n])
@@ -840,6 +940,10 @@ class TpuUniverse:
         lengths = np.asarray(self.states.length)
         out = []
         for r in range(len(self.replica_ids)):
+            host = self._text_source(r)
+            if host is not None:
+                out.append("".join(self.stores[r].objects[host]))
+                continue
             n = int(lengths[r])
             row = chars[r, :n]
             out.append(self._codepoints_to_str(row[~deleted[r, :n]]))
@@ -854,12 +958,18 @@ class TpuUniverse:
     def get_cursor(self, replica: str | int, index: int) -> Dict[str, Any]:
         """Stable cursor for a visible index (reference micromerge.ts:465-472)."""
         r = replica if isinstance(replica, int) else self.index_of[replica]
+        host = self._text_source(r)
+        if host is not None:
+            return {
+                "objectId": host,
+                "elemId": get_list_element_id(self.stores[r].metadata[host], index),
+            }
         state = index_state(self.states, r)
         ctr, act, found = K.cursor_elem_jit(state, jax.numpy.int32(index))
         if not bool(found):
             raise IndexError(f"List index out of bounds: {index}")
         return {
-            "objectId": self.roots[r].get("__lists__", {}).get("text"),
+            "objectId": self.text_objs[r],
             "elemId": make_op_id(int(ctr), self.actors.actor(int(act))),
         }
 
@@ -868,6 +978,12 @@ class TpuUniverse:
         from peritext_tpu.ids import parse_op_id
 
         r = replica if isinstance(replica, int) else self.index_of[replica]
+        obj = cursor.get("objectId")
+        if obj is not None and obj != self.text_objs[r]:
+            # Cursor into a host-side list (e.g. the LWW-winning text list
+            # when the device bound a different one).
+            _, visible = self.stores[r].find_list_element(obj, cursor["elemId"])
+            return visible
         state = index_state(self.states, r)
         ctr, actor = parse_op_id(cursor["elemId"])
         if actor not in self.actors:
@@ -885,6 +1001,10 @@ class TpuUniverse:
         (the fleet form of get_cursor)."""
         if len(indices) != len(self.replica_ids):
             raise ValueError("need one index per replica")
+        if any(self._text_source(r) is not None for r in range(len(indices))):
+            # Adversarial double-genesis fleet: some replicas' text resolves
+            # host-side; take the per-replica path.
+            return [self.get_cursor(r, i) for r, i in enumerate(indices)]
         ctrs, acts, founds = K.cursor_elems_batch(
             self.states, jax.numpy.asarray(np.asarray(indices, np.int32))
         )
@@ -896,7 +1016,7 @@ class TpuUniverse:
         acts = np.asarray(acts)
         return [
             {
-                "objectId": self.roots[r].get("__lists__", {}).get("text"),
+                "objectId": self.text_objs[r],
                 "elemId": make_op_id(int(ctrs[r]), self.actors.actor(int(acts[r]))),
             }
             for r in range(len(self.replica_ids))
@@ -908,6 +1028,11 @@ class TpuUniverse:
 
         if len(cursors) != len(self.replica_ids):
             raise ValueError("need one cursor per replica")
+        if any(
+            c.get("objectId") is not None and c.get("objectId") != self.text_objs[r]
+            for r, c in enumerate(cursors)
+        ):
+            return [self.resolve_cursor(r, c) for r, c in enumerate(cursors)]
         ctrs = np.zeros(len(cursors), np.int32)
         acts = np.zeros(len(cursors), np.int32)
         for r, cursor in enumerate(cursors):

@@ -16,6 +16,7 @@ dependency.  It serves three roles in the framework:
 from peritext_tpu.oracle.doc import (
     Doc,
     HEAD,
+    ObjectStore,
     ROOT,
     accumulate_patches,
     add_characters_to_spans,
@@ -27,6 +28,7 @@ from peritext_tpu.oracle.doc import (
 __all__ = [
     "Doc",
     "HEAD",
+    "ObjectStore",
     "ROOT",
     "accumulate_patches",
     "add_characters_to_spans",

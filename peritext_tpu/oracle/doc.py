@@ -46,6 +46,10 @@ from peritext_tpu.schema import MARK_SPEC
 ROOT = None
 HEAD = None
 
+# Patches hardcode the text path (reference micromerge.ts:643,592 emit
+# ``path: ["text"]`` for every list patch regardless of the actual object).
+CONTENT_KEY = "text"
+
 Json = Any
 MarkMap = Dict[str, Any]
 Patch = Dict[str, Any]
@@ -410,6 +414,367 @@ def op_from_wire(op: Dict[str, Any]) -> Operation:
 
 
 # ---------------------------------------------------------------------------
+# The object graph (reference micromerge.ts:534-608's per-object dispatch)
+# ---------------------------------------------------------------------------
+
+
+class ObjectStore:
+    """The CRDT object graph of one replica: objects + metadata keyed by
+    creating op id, plus the doc-global mark-op table.
+
+    Extracted from :class:`Doc` so the device engine can host the *same*
+    semantics for its structural plane: every object except the
+    device-resident text list (maps, nested lists, comment tables) applies
+    ops through this store, exactly as the reference dispatches per object
+    (micromerge.ts:534-608).  ``device_objects`` registers object ids whose
+    list state lives elsewhere (the TPU DocState); routing an op for one of
+    those here is a caller bug and raises.
+    """
+
+    def __init__(self) -> None:
+        self.objects: Dict[Optional[str], Any] = {ROOT: {}}
+        self.metadata: Dict[Optional[str], Any] = {ROOT: MapMeta()}
+        self.mark_ops: Dict[str, Operation] = {}
+        self.device_objects: Set[str] = set()
+
+    # -- path resolution (reference micromerge.ts:446-463) ------------------
+
+    def get_object_id_for_path(self, path: Sequence[str]) -> Optional[str]:
+        object_id: Optional[str] = ROOT
+        for path_elem in path:
+            meta = self.metadata.get(object_id)
+            if meta is None:
+                raise KeyError(f"No object at path {path!r}")
+            if isinstance(meta, list):
+                raise KeyError(f"Object {path_elem} in path {path!r} is a list")
+            child = meta.children.get(path_elem)
+            if child is None:
+                raise KeyError(f"Child not found: {path_elem}")
+            object_id = child
+        return object_id
+
+    # -- op dispatch (reference micromerge.ts:534-608) ----------------------
+
+    def apply_op(self, op: Operation) -> List[Patch]:
+        obj_id = op.get("obj", None)
+        if obj_id is not None and obj_id in self.device_objects:
+            raise ValueError(
+                f"op {op.get('opId')!r} targets device-resident object "
+                f"{obj_id!r}; its list ops must route through the device "
+                f"engine, not the host store"
+            )
+        metadata = self.metadata.get(obj_id, None)
+        obj = self.objects.get(obj_id, None)
+        if metadata is None or obj is None:
+            raise KeyError(f"Object does not exist: {obj_id}")
+
+        action = op["action"]
+        if action == "makeMap":
+            self.objects[op["opId"]] = {}
+            self.metadata[op["opId"]] = MapMeta()
+        elif action == "makeList":
+            self.objects[op["opId"]] = []
+            self.metadata[op["opId"]] = []
+
+        if isinstance(metadata, list):
+            if action == "set":
+                if "elemId" not in op:
+                    raise ValueError("Must specify elemId when calling set on an array")
+                return self.apply_list_insert(op)
+            if action == "del":
+                if "elemId" not in op:
+                    raise ValueError("Must specify elemId when calling del on an array")
+                return self.apply_list_update(op)
+            if action in ("addMark", "removeMark"):
+                self.mark_ops[op["opId"]] = op
+                return apply_add_remove_mark(op, obj, metadata, self.mark_ops)
+            raise NotImplementedError(f"{action} on a list")
+
+        # Map object: last-writer-wins by op id (micromerge.ts:578-602).
+        key = op.get("key")
+        if key is None:
+            raise ValueError("Must specify key when calling set or del on a map")
+        key_meta = metadata.key_ops.get(key)
+        if key_meta is None or compare_op_ids(key_meta, op["opId"]) == -1:
+            metadata.key_ops[key] = op["opId"]
+            if action == "del":
+                obj.pop(key, None)
+            elif action == "makeList":
+                obj[key] = self.objects[op["opId"]]
+                metadata.children[key] = op["opId"]
+                # Reference emits a makeList patch with hardcoded path
+                # (micromerge.ts:592).
+                return [{**op_to_wire(op), "path": ["text"]}]
+            elif action == "makeMap":
+                # Reference has a known bug here: no patch emitted
+                # (micromerge.ts:594).  We are faithful to it.
+                obj[key] = self.objects[op["opId"]]
+                metadata.children[key] = op["opId"]
+            elif action == "set":
+                obj[key] = op["value"]
+            else:
+                raise NotImplementedError(action)
+        return []
+
+    # -- RGA insert (reference micromerge.ts:614-672) -----------------------
+
+    def apply_list_insert(self, op: Operation) -> List[Patch]:
+        metadata: List[ListItem] = self.metadata[op["obj"]]
+        obj: List[str] = self.objects[op["obj"]]
+
+        # Find the reference element; insert after it.
+        if op.get("elemId") is None:
+            index, visible = -1, 0
+        else:
+            index, visible = self.find_list_element(op["obj"], op["elemId"])
+        if index >= 0 and not metadata[index].deleted:
+            visible += 1
+        index += 1
+
+        # Convergence rule for concurrent same-position inserts: skip right
+        # past any elements with elemId greater than this op's id
+        # (micromerge.ts:630-635).
+        op_id = op["opId"]
+        while index < len(metadata) and compare_op_ids(op_id, metadata[index].elem_id) < 0:
+            if not metadata[index].deleted:
+                visible += 1
+            index += 1
+
+        metadata.insert(index, ListItem(elem_id=op_id, value_id=op_id))
+        value = op["value"]
+        if not isinstance(value, str):
+            raise TypeError("Expected value inserted into text to be a string")
+        obj.insert(visible, value)
+
+        marks = get_active_marks_at_index(metadata, index, self.mark_ops)
+        return [
+            {
+                "path": [CONTENT_KEY],
+                "action": "insert",
+                "index": visible,
+                "values": [value],
+                "marks": marks,
+            }
+        ]
+
+    # -- delete (reference micromerge.ts:677-724) ---------------------------
+
+    def apply_list_update(self, op: Operation) -> List[Patch]:
+        index, visible = self.find_list_element(op["obj"], op["elemId"])
+        metadata: List[ListItem] = self.metadata[op["obj"]]
+        item = metadata[index]
+        if op["action"] == "del":
+            if not item.deleted:
+                item.deleted = True
+                self.objects[op["obj"]].pop(visible)
+                return [
+                    {
+                        "path": [CONTENT_KEY],
+                        "action": "delete",
+                        "index": visible,
+                        "count": 1,
+                    }
+                ]
+        return []
+
+    def find_list_element(
+        self, object_id: Optional[str], elem_id: str
+    ) -> Tuple[int, int]:
+        """Reference micromerge.ts:731-755 (findListElement)."""
+        meta = self.metadata.get(object_id)
+        if not isinstance(meta, list):
+            raise TypeError("Expected array metadata for find_list_element")
+        visible = 0
+        for index, item in enumerate(meta):
+            if item.elem_id == elem_id:
+                return index, visible
+            if not item.deleted:
+                visible += 1
+        raise KeyError(f"List element not found: {elem_id}")
+
+    # -- snapshot serialization (runtime/checkpoint.py sidecars) ------------
+
+    def to_json(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot of the object graph.
+
+        ROOT (None) keys map to ""; child-object values inside maps are
+        re-linked from ``children`` on load rather than serialized inline.
+        """
+        objects: Dict[str, Any] = {}
+        for obj_id, meta in self.metadata.items():
+            key = "" if obj_id is None else obj_id
+            if isinstance(meta, list):
+                objects[key] = {
+                    "type": "list",
+                    "values": list(self.objects[obj_id]),
+                    "items": [
+                        [
+                            it.elem_id,
+                            it.value_id,
+                            it.deleted,
+                            sorted(it.mark_ops_before)
+                            if it.mark_ops_before is not None
+                            else None,
+                            sorted(it.mark_ops_after)
+                            if it.mark_ops_after is not None
+                            else None,
+                        ]
+                        for it in meta
+                    ],
+                }
+            else:
+                obj = self.objects[obj_id]
+                # ``children`` entries outlive del/LWW-overwrite (the
+                # reference never prunes them, micromerge.ts:592-600), so
+                # record which keys *currently* hold their child object —
+                # only those re-link on load; a deleted key must not
+                # resurrect and an overwritten one keeps its plain value.
+                linked = sorted(
+                    k
+                    for k, cid in meta.children.items()
+                    if k in obj and obj[k] is self.objects.get(cid)
+                )
+                objects[key] = {
+                    "type": "map",
+                    "values": {k: v for k, v in obj.items() if k not in linked},
+                    "key_ops": dict(meta.key_ops),
+                    "children": dict(meta.children),
+                    "linked": linked,
+                }
+        return {
+            "objects": objects,
+            "mark_ops": {k: dict(v) for k, v in self.mark_ops.items()},
+            "device_objects": sorted(self.device_objects),
+        }
+
+    @classmethod
+    def from_json(cls, data: Dict[str, Any]) -> "ObjectStore":
+        store = cls()
+        store.objects.clear()
+        store.metadata.clear()
+        # Pass 1: create every object and its metadata.
+        for key, entry in data["objects"].items():
+            obj_id = None if key == "" else key
+            if entry["type"] == "list":
+                meta: Any = []
+                for elem_id, value_id, deleted, before, after in entry["items"]:
+                    item = ListItem(elem_id=elem_id, value_id=value_id, deleted=deleted)
+                    item.mark_ops_before = set(before) if before is not None else None
+                    item.mark_ops_after = set(after) if after is not None else None
+                    meta.append(item)
+                store.objects[obj_id] = list(entry["values"])
+                store.metadata[obj_id] = meta
+            else:
+                m = MapMeta()
+                m.key_ops = dict(entry["key_ops"])
+                m.children = dict(entry["children"])
+                store.objects[obj_id] = dict(entry["values"])
+                store.metadata[obj_id] = m
+        # Pass 2: re-link child-object references inside map values — only
+        # the keys that actually held their child at save time ("linked");
+        # stale ``children`` entries (deleted or LWW-overwritten keys) must
+        # not resurrect objects into the map.
+        for key, entry in data["objects"].items():
+            if entry["type"] == "map":
+                obj_id = None if key == "" else key
+                for child_key in entry["linked"]:
+                    child_id = entry["children"][child_key]
+                    if child_id in store.objects:
+                        store.objects[obj_id][child_key] = store.objects[child_id]
+        store.mark_ops = {k: dict(v) for k, v in data["mark_ops"].items()}
+        store.device_objects = set(data.get("device_objects", ()))
+        return store
+
+
+# ---------------------------------------------------------------------------
+# Local change generation against a store (reference micromerge.ts:308-441)
+# ---------------------------------------------------------------------------
+
+
+def generate_input_op(
+    store: ObjectStore,
+    input_op: Dict[str, Any],
+    make_new_op,
+) -> List[Patch]:
+    """Expand one InputOperation into internal ops against ``store``.
+
+    The body of the reference's change() loop (micromerge.ts:326-441),
+    parameterized over ``make_new_op(op) -> (op_id, patches)`` — the
+    caller allocates the op id, applies the op to its own state, and
+    records the wire form.  Shared by :meth:`Doc.change` and the device
+    engine's host-side generation path (TpuDoc), so the two can never
+    diverge on generation semantics.
+    """
+    obj_id = store.get_object_id_for_path(input_op["path"])
+    obj = store.objects.get(obj_id)
+    meta = store.metadata.get(obj_id)
+    if obj is None or meta is None:
+        raise KeyError(f"Object doesn't exist: {obj_id}")
+    action = input_op["action"]
+    patches: List[Patch] = []
+
+    if isinstance(obj, list) and isinstance(meta, list):
+        if action == "insert":
+            # One input op expands to one internal op per character,
+            # chained so each op references the previous
+            # (micromerge.ts:347-361).  The initial reference element
+            # uses the tombstone-peek rule.
+            elem_id = (
+                HEAD
+                if input_op["index"] == 0
+                else get_list_element_id(
+                    meta, input_op["index"] - 1, look_after_tombstones=True
+                )
+            )
+            for value in input_op["values"]:
+                elem_id, new_patches = make_new_op(
+                    {
+                        "action": "set",
+                        "obj": obj_id,
+                        "elemId": elem_id,
+                        "insert": True,
+                        "value": value,
+                    }
+                )
+                patches.extend(new_patches)
+        elif action == "delete":
+            # Constant-index repeated deletion (micromerge.ts:362-392).
+            for _ in range(input_op["count"]):
+                elem_id = get_list_element_id(meta, input_op["index"])
+                _, new_patches = make_new_op(
+                    {"action": "del", "obj": obj_id, "elemId": elem_id}
+                )
+                patches.extend(new_patches)
+        elif action in ("addMark", "removeMark"):
+            partial_op = change_mark(input_op, obj_id, meta, obj)
+            _, new_patches = make_new_op(partial_op)
+            patches.extend(new_patches)
+        elif action == "del":
+            raise ValueError("Use the delete action for lists")
+        else:
+            raise NotImplementedError(f"{action} on a list")
+    else:
+        if action in ("makeList", "makeMap", "del"):
+            _, new_patches = make_new_op(
+                {"action": action, "obj": obj_id, "key": input_op["key"]}
+            )
+            patches.extend(new_patches)
+        elif action == "set":
+            _, new_patches = make_new_op(
+                {
+                    "action": "set",
+                    "obj": obj_id,
+                    "key": input_op["key"],
+                    "value": input_op["value"],
+                }
+            )
+            patches.extend(new_patches)
+        else:
+            raise TypeError(f"Not a list: {input_op['path']}")
+    return patches
+
+
+# ---------------------------------------------------------------------------
 # The document
 # ---------------------------------------------------------------------------
 
@@ -424,18 +789,30 @@ class Doc:
     materializes formatted spans; cursors resolve through tombstones.
     """
 
-    CONTENT_KEY = "text"
+    CONTENT_KEY = CONTENT_KEY
 
     def __init__(self, actor_id: str):
         self.actor_id = actor_id
         self.seq = 0
         self.max_op = 0
         self.clock: Dict[str, int] = {}
-        # Objects and metadata keyed by creating op id; ROOT is None.
-        self.objects: Dict[Optional[str], Any] = {ROOT: {}}
-        self.metadata: Dict[Optional[str], Any] = {ROOT: MapMeta()}
-        # Doc-global mark-op table: op id -> internal mark operation.
-        self.mark_ops: Dict[str, Operation] = {}
+        # The object graph (objects/metadata keyed by creating op id,
+        # ROOT is None) plus the doc-global mark-op table.
+        self.store = ObjectStore()
+
+    # -- store views (kept as attributes for the differential tests) --------
+
+    @property
+    def objects(self) -> Dict[Optional[str], Any]:
+        return self.store.objects
+
+    @property
+    def metadata(self) -> Dict[Optional[str], Any]:
+        return self.store.metadata
+
+    @property
+    def mark_ops(self) -> Dict[str, Operation]:
+        return self.store.mark_ops
 
     # -- public accessors ---------------------------------------------------
 
@@ -445,18 +822,7 @@ class Doc:
 
     def get_object_id_for_path(self, path: Sequence[str]) -> Optional[str]:
         """Reference micromerge.ts:446-463 (getObjectIdForPath)."""
-        object_id: Optional[str] = ROOT
-        for path_elem in path:
-            meta = self.metadata.get(object_id)
-            if meta is None:
-                raise KeyError(f"No object at path {path!r}")
-            if isinstance(meta, list):
-                raise KeyError(f"Object {path_elem} in path {path!r} is a list")
-            child = meta.children.get(path_elem)
-            if child is None:
-                raise KeyError(f"Child not found: {path_elem}")
-            object_id = child
-        return object_id
+        return self.store.get_object_id_for_path(path)
 
     def get_text_with_formatting(self, path: Sequence[str]) -> List[Dict[str, Any]]:
         """Reference micromerge.ts:516-529."""
@@ -501,76 +867,12 @@ class Doc:
             "ops": [],
         }
         patches: List[Patch] = []
-
         for input_op in input_ops:
-            obj_id = self.get_object_id_for_path(input_op["path"])
-            obj = self.objects.get(obj_id)
-            meta = self.metadata.get(obj_id)
-            if obj is None or meta is None:
-                raise KeyError(f"Object doesn't exist: {obj_id}")
-            action = input_op["action"]
-
-            if isinstance(obj, list) and isinstance(meta, list):
-                if action == "insert":
-                    # One input op expands to one internal op per character,
-                    # chained so each op references the previous
-                    # (micromerge.ts:347-361).  The initial reference element
-                    # uses the tombstone-peek rule.
-                    elem_id = (
-                        HEAD
-                        if input_op["index"] == 0
-                        else get_list_element_id(
-                            meta, input_op["index"] - 1, look_after_tombstones=True
-                        )
-                    )
-                    for value in input_op["values"]:
-                        elem_id, new_patches = self._make_new_op(
-                            change,
-                            {
-                                "action": "set",
-                                "obj": obj_id,
-                                "elemId": elem_id,
-                                "insert": True,
-                                "value": value,
-                            },
-                        )
-                        patches.extend(new_patches)
-                elif action == "delete":
-                    # Constant-index repeated deletion (micromerge.ts:362-392).
-                    for _ in range(input_op["count"]):
-                        elem_id = get_list_element_id(meta, input_op["index"])
-                        _, new_patches = self._make_new_op(
-                            change, {"action": "del", "obj": obj_id, "elemId": elem_id}
-                        )
-                        patches.extend(new_patches)
-                elif action in ("addMark", "removeMark"):
-                    partial_op = change_mark(input_op, obj_id, meta, obj)
-                    _, new_patches = self._make_new_op(change, partial_op)
-                    patches.extend(new_patches)
-                elif action == "del":
-                    raise ValueError("Use the delete action for lists")
-                else:
-                    raise NotImplementedError(f"{action} on a list")
-            else:
-                if action in ("makeList", "makeMap", "del"):
-                    _, new_patches = self._make_new_op(
-                        change, {"action": action, "obj": obj_id, "key": input_op["key"]}
-                    )
-                    patches.extend(new_patches)
-                elif action == "set":
-                    _, new_patches = self._make_new_op(
-                        change,
-                        {
-                            "action": "set",
-                            "obj": obj_id,
-                            "key": input_op["key"],
-                            "value": input_op["value"],
-                        },
-                    )
-                    patches.extend(new_patches)
-                else:
-                    raise TypeError(f"Not a list: {input_op['path']}")
-
+            patches.extend(
+                generate_input_op(
+                    self.store, input_op, lambda op: self._make_new_op(change, op)
+                )
+            )
         return change, patches
 
     def _make_new_op(
@@ -611,135 +913,13 @@ class Doc:
     # -- op dispatch (reference micromerge.ts:534-608) ----------------------
 
     def _apply_op(self, op: Operation) -> List[Patch]:
-        obj_id = op.get("obj", None)
-        metadata = self.metadata.get(obj_id, None)
-        obj = self.objects.get(obj_id, None)
-        if metadata is None or obj is None:
-            raise KeyError(f"Object does not exist: {obj_id}")
-
-        action = op["action"]
-        if action == "makeMap":
-            self.objects[op["opId"]] = {}
-            self.metadata[op["opId"]] = MapMeta()
-        elif action == "makeList":
-            self.objects[op["opId"]] = []
-            self.metadata[op["opId"]] = []
-
-        if isinstance(metadata, list):
-            if action == "set":
-                if "elemId" not in op:
-                    raise ValueError("Must specify elemId when calling set on an array")
-                return self._apply_list_insert(op)
-            if action == "del":
-                if "elemId" not in op:
-                    raise ValueError("Must specify elemId when calling del on an array")
-                return self._apply_list_update(op)
-            if action in ("addMark", "removeMark"):
-                self.mark_ops[op["opId"]] = op
-                return apply_add_remove_mark(op, obj, metadata, self.mark_ops)
-            raise NotImplementedError(f"{action} on a list")
-
-        # Map object: last-writer-wins by op id (micromerge.ts:578-602).
-        key = op.get("key")
-        if key is None:
-            raise ValueError("Must specify key when calling set or del on a map")
-        key_meta = metadata.key_ops.get(key)
-        if key_meta is None or compare_op_ids(key_meta, op["opId"]) == -1:
-            metadata.key_ops[key] = op["opId"]
-            if action == "del":
-                obj.pop(key, None)
-            elif action == "makeList":
-                obj[key] = self.objects[op["opId"]]
-                metadata.children[key] = op["opId"]
-                # Reference emits a makeList patch with hardcoded path
-                # (micromerge.ts:592).
-                return [{**op_to_wire(op), "path": ["text"]}]
-            elif action == "makeMap":
-                # Reference has a known bug here: no patch emitted
-                # (micromerge.ts:594).  We are faithful to it.
-                obj[key] = self.objects[op["opId"]]
-                metadata.children[key] = op["opId"]
-            elif action == "set":
-                obj[key] = op["value"]
-            else:
-                raise NotImplementedError(action)
-        return []
-
-    # -- RGA insert (reference micromerge.ts:614-672) -----------------------
-
-    def _apply_list_insert(self, op: Operation) -> List[Patch]:
-        metadata: List[ListItem] = self.metadata[op["obj"]]
-        obj: List[str] = self.objects[op["obj"]]
-
-        # Find the reference element; insert after it.
-        if op.get("elemId") is None:
-            index, visible = -1, 0
-        else:
-            index, visible = self._find_list_element(op["obj"], op["elemId"])
-        if index >= 0 and not metadata[index].deleted:
-            visible += 1
-        index += 1
-
-        # Convergence rule for concurrent same-position inserts: skip right
-        # past any elements with elemId greater than this op's id
-        # (micromerge.ts:630-635).
-        op_id = op["opId"]
-        while index < len(metadata) and compare_op_ids(op_id, metadata[index].elem_id) < 0:
-            if not metadata[index].deleted:
-                visible += 1
-            index += 1
-
-        metadata.insert(index, ListItem(elem_id=op_id, value_id=op_id))
-        value = op["value"]
-        if not isinstance(value, str):
-            raise TypeError("Expected value inserted into text to be a string")
-        obj.insert(visible, value)
-
-        marks = get_active_marks_at_index(metadata, index, self.mark_ops)
-        return [
-            {
-                "path": [Doc.CONTENT_KEY],
-                "action": "insert",
-                "index": visible,
-                "values": [value],
-                "marks": marks,
-            }
-        ]
-
-    # -- delete (reference micromerge.ts:677-724) ---------------------------
-
-    def _apply_list_update(self, op: Operation) -> List[Patch]:
-        index, visible = self._find_list_element(op["obj"], op["elemId"])
-        metadata: List[ListItem] = self.metadata[op["obj"]]
-        item = metadata[index]
-        if op["action"] == "del":
-            if not item.deleted:
-                item.deleted = True
-                self.objects[op["obj"]].pop(visible)
-                return [
-                    {
-                        "path": [Doc.CONTENT_KEY],
-                        "action": "delete",
-                        "index": visible,
-                        "count": 1,
-                    }
-                ]
-        return []
+        return self.store.apply_op(op)
 
     def _find_list_element(
         self, object_id: Optional[str], elem_id: str
     ) -> Tuple[int, int]:
         """Reference micromerge.ts:731-755 (findListElement)."""
-        meta = self.metadata.get(object_id)
-        if not isinstance(meta, list):
-            raise TypeError("Expected array metadata for find_list_element")
-        visible = 0
-        for index, item in enumerate(meta):
-            if item.elem_id == elem_id:
-                return index, visible
-            if not item.deleted:
-                visible += 1
-        raise KeyError(f"List element not found: {elem_id}")
+        return self.store.find_list_element(object_id, elem_id)
 
 
 # ---------------------------------------------------------------------------

@@ -10,7 +10,8 @@ resume replay only the log tail past the snapshot's vector clocks.
 Format:
 - ``<path>.npz``  — every DocState leaf, batched [R, ...]
 - ``<path>.json`` — replica ids, per-replica clocks/lengths/mark counts,
-  actor and attr intern tables, capacities, roots
+  actor and attr intern tables, capacities, host object stores + device
+  text-list bindings
 """
 from __future__ import annotations
 
@@ -26,6 +27,7 @@ from peritext_tpu.ids import ActorRegistry
 from peritext_tpu.ops.encode import AttrRegistry
 from peritext_tpu.ops.state import DocState
 from peritext_tpu.ops.universe import TpuUniverse
+from peritext_tpu.oracle.doc import ObjectStore
 
 import dataclasses
 
@@ -45,7 +47,8 @@ def save_universe(uni: TpuUniverse, path: str) -> None:
         "clocks": uni.clocks,
         "lengths": uni.lengths,
         "mark_counts": uni.mark_counts,
-        "roots": uni.roots,
+        "stores": [s.to_json() for s in uni.stores],
+        "text_objs": uni.text_objs,
         "capacity": uni.capacity,
         "max_mark_ops": uni.max_mark_ops,
         "max_actors": uni.max_actors,
@@ -119,7 +122,20 @@ def load_universe(path: str) -> TpuUniverse:
     uni.clocks = [dict(c) for c in sidecar["clocks"]]
     uni.lengths = list(sidecar["lengths"])
     uni.mark_counts = list(sidecar["mark_counts"])
-    uni.roots = [dict(r) for r in sidecar["roots"]]
+    uni.stores = [ObjectStore.from_json(s) for s in sidecar["stores"]]
+    uni.text_objs = list(sidecar["text_objs"])
+    # Reconstruct store-version classes from content so a restored converged
+    # fleet keeps the one-copy-per-class host plane (universe.store_versions
+    # invariant: equal version ⟹ equal store).
+    digest_version: Dict[str, int] = {}
+    versions = []
+    for s in sidecar["stores"]:
+        d = json.dumps(s, sort_keys=True)
+        if d not in digest_version:
+            uni._store_version_counter += 1
+            digest_version[d] = uni._store_version_counter
+        versions.append(digest_version[d])
+    uni.store_versions = versions
     actors = ActorRegistry()
     for actor in sidecar["actors"]:
         actors.intern(actor)

@@ -381,26 +381,58 @@ def test_gate_failure_cannot_strand_other_replicas(monkeypatch):
     assert uni.text("doc1") == doc1_text
 
 
-def test_second_list_ops_raise_instead_of_corrupting():
-    """A change creating a second list and inserting into it must raise at
-    ingestion (round-1 VERDICT: such inserts were silently spliced into the
-    text document)."""
+def test_second_list_ops_route_to_the_host_store():
+    """A change creating a second list and inserting into it applies on the
+    host structural plane (the oracle's per-object dispatch,
+    micromerge.ts:534-608) and never touches the device text document.
+    Round-1 VERDICT: such inserts were silently spliced into the text;
+    round 2 made them a loud error; now they are supported."""
     docs, _, initial_change = generate_docs("safe")
     doc1, _ = docs
     uni = TpuUniverse(["doc1"])
     uni.apply_changes({"doc1": [initial_change]})
 
-    hostile, _ = doc1.change(
+    second, _ = doc1.change(
         [
             {"path": [], "action": "makeList", "key": "other"},
-            {"path": ["other"], "action": "insert", "index": 0, "values": ["E", "V", "I", "L"]},
+            {"path": ["other"], "action": "insert", "index": 0, "values": ["n", "i", "c", "e"]},
         ]
     )
     before = uni.text("doc1")
+    uni.apply_changes({"doc1": [second]})
+    # Text untouched; second list materialized host-side with oracle content.
+    assert uni.text("doc1") == before
+    assert uni.stores[0].objects[uni.stores[0].metadata[None].children["other"]] == list("nice")
+    assert doc1.root["other"] == list("nice")
+
+
+def test_ops_on_unknown_object_raise_before_commit():
+    """An op targeting an object id that exists nowhere must fail loudly at
+    ingestion and commit nothing (no silent splicing, no stranded clock)."""
+    docs, _, initial_change = generate_docs("unknown-obj")
+    doc1, _ = docs
+    uni = TpuUniverse(["doc1"])
+    uni.apply_changes({"doc1": [initial_change]})
+
+    hostile = {
+        "actor": doc1.actor_id,
+        "seq": 2,
+        "deps": dict(uni.clock("doc1")),
+        "startOp": 100,
+        "ops": [
+            {
+                "opId": f"100@{doc1.actor_id}",
+                "action": "set",
+                "obj": "99@nobody",
+                "insert": True,
+                "value": "X",
+            }
+        ],
+    }
+    before = uni.text("doc1")
     clock_before = uni.clock("doc1")
-    with pytest.raises(ValueError, match="text list"):
+    with pytest.raises(KeyError, match="Object does not exist"):
         uni.apply_changes({"doc1": [hostile]})
-    # And the failed ingestion must not have committed anything.
     assert uni.text("doc1") == before
     assert uni.clock("doc1") == clock_before
 

@@ -1,0 +1,356 @@
+"""Nested objects on the device engine: host structural plane differential.
+
+The reference dispatches every op per target object (micromerge.ts:534-608):
+the root map, nested maps, any number of lists.  The device engine binds the
+root text list to the TPU data plane and hosts every *other* object in a
+per-replica ObjectStore sharing the oracle's exact code.  These tests drive
+nested makeMap/makeList/set/del, second-list inserts/deletes/marks, and
+mixed text+structural changes through TpuDoc/TpuUniverse and assert wire,
+patch, view, and convergence equality against oracle Docs.
+"""
+import pytest
+
+from peritext_tpu.ops import TpuDoc, TpuUniverse
+from peritext_tpu.oracle import Doc
+
+B = {"active": True}
+
+
+def seeded(actor_tpu="doc2", text="Hello"):
+    """An oracle doc, a TpuDoc peer, and a same-actor shadow oracle, all
+    bootstrapped from one genesis."""
+    oracle = Doc("doc1")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list(text)},
+        ]
+    )
+    tpu = TpuDoc(actor_tpu)
+    tpu.apply_change(genesis)
+    shadow = Doc(actor_tpu)
+    shadow.apply_change(genesis)
+    return oracle, tpu, shadow, genesis
+
+
+NESTED_OPS = [
+    {"path": [], "action": "makeMap", "key": "meta"},
+    {"path": ["meta"], "action": "set", "key": "title", "value": "T"},
+    {"path": ["meta"], "action": "makeMap", "key": "author"},
+    {"path": ["meta", "author"], "action": "set", "key": "name", "value": "sam"},
+    {"path": [], "action": "makeList", "key": "tags"},
+    {"path": ["tags"], "action": "insert", "index": 0, "values": ["a", "b", "c"]},
+    {"path": ["tags"], "action": "delete", "index": 1, "count": 1},
+    {"path": ["meta"], "action": "del", "key": "title"},
+]
+
+
+def test_nested_generation_matches_oracle_wire_and_patches():
+    _, tpu, shadow, _ = seeded()
+    expected_change, expected_patches = shadow.change(NESTED_OPS)
+    actual_change, actual_patches = tpu.change(NESTED_OPS)
+    assert actual_change == expected_change
+    assert actual_patches == expected_patches
+
+
+def test_nested_views_match_oracle():
+    _, tpu, shadow, _ = seeded()
+    shadow.change(NESTED_OPS)
+    tpu.change(NESTED_OPS)
+    root_o = shadow.root
+    root_t = tpu.root
+    assert root_t["meta"] == root_o["meta"]
+    assert root_t["tags"] == root_o["tags"] == ["a", "c"]
+    assert root_t["text"] == root_o["text"]
+
+
+def test_second_list_marks_match_oracle():
+    _, tpu, shadow, _ = seeded()
+    ops = [
+        {"path": [], "action": "makeList", "key": "notes"},
+        {"path": ["notes"], "action": "insert", "index": 0, "values": list("margin")},
+        {"path": ["notes"], "action": "addMark", "startIndex": 1, "endIndex": 4, "markType": "strong"},
+        {"path": ["notes"], "action": "addMark", "startIndex": 2, "endIndex": 6, "markType": "em"},
+        {"path": ["notes"], "action": "removeMark", "startIndex": 3, "endIndex": 5, "markType": "strong"},
+    ]
+    ec, ep = shadow.change(ops)
+    ac, ap = tpu.change(ops)
+    assert ac == ec
+    assert ap == ep
+    assert tpu.get_text_with_formatting(["notes"]) == shadow.get_text_with_formatting(
+        ["notes"]
+    )
+    # The device text list is untouched and still renders through the device.
+    assert tpu.get_text_with_formatting(["text"]) == shadow.get_text_with_formatting(
+        ["text"]
+    )
+
+
+def test_mixed_text_and_structural_change_interleaves_patches():
+    """One change mixing device-text ops and host-object ops must emit the
+    oracle's exact patch stream, in op order, through apply_change."""
+    oracle, tpu, shadow, _ = seeded()
+    mixed, _ = oracle.change(
+        [
+            {"path": ["text"], "action": "insert", "index": 0, "values": ["x"]},
+            {"path": [], "action": "makeList", "key": "side"},
+            {"path": ["side"], "action": "insert", "index": 0, "values": ["1", "2"]},
+            {"path": ["text"], "action": "insert", "index": 1, "values": ["y"]},
+            {"path": [], "action": "set", "key": "rev", "value": 7},
+            {"path": ["text"], "action": "delete", "index": 0, "count": 1},
+        ]
+    )
+    expected = shadow.apply_change(mixed)
+    actual = tpu.apply_change(mixed)
+    assert actual == expected
+    assert tpu.root["side"] == shadow.root["side"] == ["1", "2"]
+    assert tpu.root["rev"] == 7
+    assert tpu.root["text"] == shadow.root["text"]
+
+
+def test_concurrent_second_list_inserts_converge():
+    """RGA convergence on a host-side list across a TpuDoc and an oracle."""
+    oracle, tpu, shadow, _ = seeded()
+    base, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "chat"},
+            {"path": ["chat"], "action": "insert", "index": 0, "values": list("AB")},
+        ]
+    )
+    shadow.apply_change(base)
+    tpu.apply_change(base)
+    c1, _ = shadow.change(
+        [{"path": ["chat"], "action": "insert", "index": 1, "values": list("xy")}]
+    )
+    c2, _ = oracle.change(
+        [{"path": ["chat"], "action": "insert", "index": 1, "values": list("pq")}]
+    )
+    shadow.apply_change(c2)
+    oracle.apply_change(c1)
+    tpu.apply_change(c2)
+    tpu.apply_change(c1)
+    assert tpu.root["chat"] == shadow.root["chat"] == oracle.root["chat"]
+
+
+def test_universe_fleet_converges_on_nested_objects():
+    """Two universe replicas ingesting nested-object changes in different
+    orders converge on host stores and device text alike."""
+    oracle = Doc("a")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("base")},
+        ]
+    )
+    peer = Doc("b")
+    peer.apply_change(genesis)
+    c1, _ = oracle.change(
+        [
+            {"path": [], "action": "makeMap", "key": "m"},
+            {"path": ["m"], "action": "set", "key": "k", "value": 1},
+            {"path": ["text"], "action": "insert", "index": 4, "values": ["!"]},
+        ]
+    )
+    c2, _ = peer.change(
+        [
+            {"path": [], "action": "makeList", "key": "l"},
+            {"path": ["l"], "action": "insert", "index": 0, "values": list("zz")},
+        ]
+    )
+    uni = TpuUniverse(["r1", "r2"])
+    uni.apply_changes({"r1": [genesis, c1, c2], "r2": [genesis, c2, c1]})
+    assert uni.text("r1") == uni.text("r2") == "base!"
+    s1, s2 = uni.stores[0], uni.stores[1]
+    root1 = s1.objects[None]
+    root2 = s2.objects[None]
+    assert root1["m"] == root2["m"] == {"k": 1}
+    assert root1["l"] == root2["l"] == ["z", "z"]
+    # LWW metadata converged too.
+    assert s1.metadata[None].key_ops == s2.metadata[None].key_ops
+
+
+def test_universe_patched_path_interleaves_host_patches():
+    """apply_changes_with_patches must emit host-object patches at their op
+    positions (the oracle's exact stream), not batched up front."""
+    oracle = Doc("a")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("hi")},
+        ]
+    )
+    mixed, _ = oracle.change(
+        [
+            {"path": ["text"], "action": "insert", "index": 2, "values": ["?"]},
+            {"path": [], "action": "makeList", "key": "z"},
+            {"path": ["z"], "action": "insert", "index": 0, "values": ["q"]},
+        ]
+    )
+    shadow = Doc("shadow")
+    expected = shadow.apply_change(genesis) + shadow.apply_change(mixed)
+    uni = TpuUniverse(["r"])
+    got = uni.apply_changes_with_patches({"r": [genesis]})["r"]
+    got += uni.apply_changes_with_patches({"r": [mixed]})["r"]
+    assert got == expected
+
+
+def test_cursor_on_host_list_matches_oracle():
+    _, tpu, shadow, _ = seeded()
+    ops = [
+        {"path": [], "action": "makeList", "key": "items"},
+        {"path": ["items"], "action": "insert", "index": 0, "values": list("wxyz")},
+    ]
+    shadow.change(ops)
+    tpu.change(ops)
+    c_o = shadow.get_cursor(["items"], 2)
+    c_t = tpu.get_cursor(["items"], 2)
+    assert c_t == c_o
+    del_ops = [{"path": ["items"], "action": "delete", "index": 0, "count": 1}]
+    shadow.change(del_ops)
+    tpu.change(del_ops)
+    assert tpu.resolve_cursor(c_t) == shadow.resolve_cursor(c_o) == 1
+
+
+def test_checkpoint_roundtrip_preserves_nested_state(tmp_path):
+    from peritext_tpu.runtime.checkpoint import load_universe, save_universe
+
+    oracle = Doc("a")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("snap")},
+        ]
+    )
+    nested, _ = oracle.change(NESTED_OPS)
+    uni = TpuUniverse(["r"])
+    uni.apply_changes({"r": [genesis, nested]})
+    path = str(tmp_path / "snap")
+    save_universe(uni, path)
+    loaded = load_universe(path)
+    assert loaded.text_objs == uni.text_objs
+    assert loaded.stores[0].to_json() == uni.stores[0].to_json()
+    assert loaded.text("r") == uni.text("r")
+    # The restored store keeps working: another nested change applies.
+    more, _ = oracle.change(
+        [{"path": ["tags"], "action": "insert", "index": 0, "values": ["n"]}]
+    )
+    loaded.apply_changes({"r": [more]})
+    assert loaded.stores[0].objects[
+        loaded.stores[0].metadata[None].children["tags"]
+    ] == ["n", "a", "c"]
+
+
+def test_concurrent_root_text_makelists_converge_with_oracle():
+    """Adversarial double genesis: two actors concurrently create root.text.
+    Replicas binding different device lists must still converge — every view
+    resolves root.text through map-key LWW (micromerge.ts:578-602), exactly
+    like the oracle, whichever list the device plane bound first."""
+    a, b = Doc("a"), Doc("b")
+    ga, _ = a.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("aaa")},
+        ]
+    )
+    gb, _ = b.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("bbb")},
+        ]
+    )
+    a.apply_change(gb)
+    b.apply_change(ga)
+    expected = a.get_text_with_formatting(["text"])
+    assert expected == b.get_text_with_formatting(["text"])
+
+    uni = TpuUniverse(["r1", "r2"])
+    uni.apply_changes({"r1": [ga, gb], "r2": [gb, ga]})
+    assert uni.text("r1") == uni.text("r2") == "".join(a.root["text"])
+    assert uni.spans("r1") == uni.spans("r2") == expected
+    assert uni.texts() == [uni.text("r1")] * 2
+    assert uni.spans_batch() == [expected, expected]
+    # Cursors work against whichever list LWW elected, on both replicas.
+    c1 = uni.get_cursor("r1", 1)
+    c2 = uni.get_cursor("r2", 1)
+    assert c1 == c2
+    assert uni.resolve_cursor("r1", c1) == uni.resolve_cursor("r2", c2) == 1
+
+    # TpuDocs in both delivery orders agree with the oracle too.
+    t1, t2 = TpuDoc("t1"), TpuDoc("t2")
+    t1.apply_change(ga)
+    t1.apply_change(gb)
+    t2.apply_change(gb)
+    t2.apply_change(ga)
+    assert t1.get_text_with_formatting(["text"]) == expected
+    assert t2.get_text_with_formatting(["text"]) == expected
+    assert t1.root["text"] == t2.root["text"] == a.root["text"]
+
+
+def test_checkpoint_does_not_resurrect_deleted_or_overwritten_keys(tmp_path):
+    """Snapshot round-trip regressions: a deleted map key must stay deleted
+    and an LWW-overwritten list key must keep its plain value (stale
+    ``children`` entries never re-link on load)."""
+    from peritext_tpu.runtime.checkpoint import load_universe, save_universe
+
+    oracle = Doc("a")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": ["h"]},
+        ]
+    )
+    churn, _ = oracle.change(
+        [
+            {"path": [], "action": "makeMap", "key": "meta"},
+            {"path": [], "action": "del", "key": "meta"},
+            {"path": [], "action": "makeList", "key": "x"},
+            {"path": [], "action": "set", "key": "x", "value": 5},
+        ]
+    )
+    uni = TpuUniverse(["r"])
+    uni.apply_changes({"r": [genesis, churn]})
+    root_before = dict(uni.stores[0].objects[None])
+    assert "meta" not in root_before and root_before["x"] == 5
+
+    path = str(tmp_path / "snap")
+    save_universe(uni, path)
+    loaded = load_universe(path)
+    root_after = dict(loaded.stores[0].objects[None])
+    assert "meta" not in root_after
+    assert root_after["x"] == 5
+
+
+def test_converged_fleet_shares_one_host_store_copy():
+    """Replicas ingesting the same stream from the same state form one
+    version class: the host plane applies host ops ONCE and shares the
+    resulting store instance (the R=100k genesis fast path)."""
+    oracle = Doc("a")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("go")},
+        ]
+    )
+    nested, _ = oracle.change(NESTED_OPS)
+    uni = TpuUniverse(["r1", "r2", "r3"])
+    uni.apply_changes({"r1": [genesis], "r2": [genesis], "r3": [genesis]})
+    assert uni.stores[0] is uni.stores[1] is uni.stores[2]
+    assert len(set(uni.store_versions)) == 1
+    uni.apply_changes({"r1": [nested], "r2": [nested], "r3": [nested]})
+    assert uni.stores[0] is uni.stores[1] is uni.stores[2]
+    # A divergent replica leaves the class and gets its own store.
+    solo, _ = oracle.change(
+        [{"path": ["tags"], "action": "insert", "index": 0, "values": ["s"]}]
+    )
+    uni.apply_changes({"r1": [solo], "r2": [], "r3": []})
+    assert uni.stores[0] is not uni.stores[1]
+    assert uni.stores[1] is uni.stores[2]
+    assert uni.store_versions[0] != uni.store_versions[1]
+
+
+def test_unknown_nested_path_raises():
+    _, tpu, shadow, _ = seeded()
+    with pytest.raises(KeyError):
+        shadow.change([{"path": ["nope"], "action": "insert", "index": 0, "values": ["x"]}])
+    with pytest.raises(KeyError):
+        tpu.change([{"path": ["nope"], "action": "insert", "index": 0, "values": ["x"]}])
