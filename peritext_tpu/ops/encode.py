@@ -220,7 +220,15 @@ def encode_changes(
                 # on a host-side (non-device) list: the host store applies
                 # it.  Route before encoding — host lists may hold values the
                 # device char plane can't (and must not) encode.
-                if op["action"] == "makeList" and op.get("key") == "text" and text_obj is None:
+                # The device binding is the first makeList with key "text"
+                # on the ROOT map only (absent obj == ROOT on the wire); a
+                # "text"-keyed list inside a nested map stays host-side.
+                if (
+                    op["action"] == "makeList"
+                    and op.get("obj") is None
+                    and op.get("key") == "text"
+                    and text_obj is None
+                ):
                     text_obj = op["opId"]
                 host_ops.append((pos, op))
             else:

@@ -122,19 +122,24 @@ def load_universe(path: str) -> TpuUniverse:
     uni.clocks = [dict(c) for c in sidecar["clocks"]]
     uni.lengths = list(sidecar["lengths"])
     uni.mark_counts = list(sidecar["mark_counts"])
-    uni.stores = [ObjectStore.from_json(s) for s in sidecar["stores"]]
     uni.text_objs = list(sidecar["text_objs"])
     # Reconstruct store-version classes from content so a restored converged
     # fleet keeps the one-copy-per-class host plane (universe.store_versions
-    # invariant: equal version ⟹ equal store).
+    # invariant: equal version ⟹ equal store): deserialize ONE store per
+    # distinct digest and share the instance across its class — restore is
+    # O(classes), not O(R), in both time and memory.
     digest_version: Dict[str, int] = {}
-    versions = []
+    digest_store: Dict[str, ObjectStore] = {}
+    versions, stores = [], []
     for s in sidecar["stores"]:
         d = json.dumps(s, sort_keys=True)
         if d not in digest_version:
             uni._store_version_counter += 1
             digest_version[d] = uni._store_version_counter
+            digest_store[d] = ObjectStore.from_json(s)
         versions.append(digest_version[d])
+        stores.append(digest_store[d])
+    uni.stores = stores
     uni.store_versions = versions
     actors = ActorRegistry()
     for actor in sidecar["actors"]:

@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Relay-free XLA:TPU compile timing for the merge paths at bench shape.
+
+The headline bench compiles merge_step_sorted_batch (and friends) on first
+contact with the TPU; on the relayed chip a pathological compile is
+indistinguishable from a wedge.  This script compiles the same kernels
+ahead of time against an abstract v5e topology with the image's local
+libtpu — same compiler, no relay — and reports wall-clock per path, so a
+compile-time pathology can be localized (and fixed) without hardware.
+
+PERITEXT_SPLICE is read at kernel *import* time, so each strategy runs in
+its own subprocess:
+
+    python scripts/aot_merge_compile_timing.py            # all paths
+    python scripts/aot_merge_compile_timing.py sort       # one path
+"""
+import os
+import subprocess
+import sys
+import time
+
+PATHS = ["sort", "scatter", "roll", "scan"]
+
+
+def run_one(path: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if path != "scan":
+        os.environ["PERITEXT_SPLICE"] = path
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+    from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
+    from peritext_tpu.ops import kernels as K
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=os.environ.get("AOT_TOPOLOGY", "v5e:2x2x1")
+    )
+    mesh = Mesh(np.array(topo.devices).reshape(-1), ("x",))
+    row = NamedSharding(mesh, P("x"))
+    repl = NamedSharding(mesh, P())
+
+    # The bench's exact shape (run_bench defaults): R=1024, 1k-char docs,
+    # 64-op concurrent batches, 8 chained rounds.
+    R, doc_len, ops_per_merge, rounds = 1024, 1000, 64, 8
+    workload = make_merge_workload(doc_len, ops_per_merge, 4, True, 0)
+    capacity = 1
+    while capacity < doc_len + (rounds + 1) * ops_per_merge + 8:
+        capacity *= 2
+    batch = build_device_batch(workload, R, capacity, 1024)
+    use_scan = path == "scan"
+    sp = prepare_sorted_batch(
+        [batch["text_ops"][r] for r in range(R)],
+        max_run=K.MAX_RUN_LEN if use_scan else 0,
+    )
+
+    def sds(x, sh):
+        x = jnp.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    st_sds = jax.tree.map(lambda x: sds(x, row), batch["states"])
+    text = sds(sp["text"], row)
+    marks = sds(batch["mark_ops"], row)
+    ranks = sds(batch["ranks"], repl)
+    bufs = sds(sp["bufs"], row)
+    rounds_sds = sds(sp["rounds"], row)
+
+    if use_scan:
+        fn = lambda st, t, m, rk, b: K.merge_step_fused_batch(st, t, m, rk, b)
+        args = (st_sds, text, marks, ranks, bufs)
+    else:
+        fn = lambda st, t, ro, m, rk, b: K.merge_step_sorted_batch(
+            st, t, ro, sp["num_rounds"], m, rk, b, sp["maxk"]
+        )
+        args = (st_sds, text, rounds_sds, marks, ranks, bufs)
+
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    mem = getattr(compiled, "memory_analysis", lambda: None)()
+    extra = ""
+    if mem is not None:
+        extra = f" temp={getattr(mem, 'temp_size_in_bytes', 0)/2**20:.0f}MiB"
+    print(
+        f"aot[{path}]: lower={t1 - t0:.1f}s compile={t2 - t1:.1f}s"
+        f" rounds={sp['num_rounds']} maxk={sp['maxk']}{extra}",
+        flush=True,
+    )
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        return run_one(sys.argv[1])
+    rc = 0
+    for path in PATHS:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__), path])
+        rc = rc or r.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -348,6 +348,53 @@ def test_converged_fleet_shares_one_host_store_copy():
     assert uni.store_versions[0] != uni.store_versions[1]
 
 
+def test_nested_text_keyed_list_does_not_steal_the_device_binding():
+    """A makeList with key "text" inside a NESTED map must stay host-side;
+    only the ROOT map's first "text" list binds the device plane (regression:
+    encode_changes once matched on key alone and bound the nested list)."""
+    oracle = Doc("a")
+    tricky, _ = oracle.change(
+        [
+            {"path": [], "action": "makeMap", "key": "meta"},
+            {"path": ["meta"], "action": "makeList", "key": "text"},
+            {"path": ["meta", "text"], "action": "insert", "index": 0, "values": ["N"]},
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": ["R"]},
+        ]
+    )
+    tpu = TpuDoc("t")
+    tpu.apply_change(tricky)
+    assert tpu.get_text_with_formatting(["meta", "text"]) == oracle.get_text_with_formatting(["meta", "text"])
+    assert tpu.get_text_with_formatting(["text"]) == oracle.get_text_with_formatting(["text"])
+    assert tpu.root["meta"]["text"] == ["N"]
+    assert tpu.root["text"] == ["R"]
+    uni = TpuUniverse(["r"])
+    uni.apply_changes({"r": [tricky]})
+    assert uni.text("r") == "R"
+    store = uni.stores[0]
+    nested_list = store.objects[store.metadata[None].children["meta"]]["text"]
+    assert nested_list == ["N"]
+
+
+def test_checkpoint_restore_shares_stores_per_class(tmp_path):
+    from peritext_tpu.runtime.checkpoint import load_universe, save_universe
+
+    oracle = Doc("a")
+    genesis, _ = oracle.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": ["x"]},
+        ]
+    )
+    uni = TpuUniverse(["r1", "r2", "r3"])
+    uni.apply_changes({"r1": [genesis], "r2": [genesis], "r3": [genesis]})
+    path = str(tmp_path / "snap")
+    save_universe(uni, path)
+    loaded = load_universe(path)
+    assert loaded.stores[0] is loaded.stores[1] is loaded.stores[2]
+    assert len(set(loaded.store_versions)) == 1
+
+
 def test_unknown_nested_path_raises():
     _, tpu, shadow, _ = seeded()
     with pytest.raises(KeyError):
