@@ -18,10 +18,51 @@ tunnel still yields an honest—if slower—measurement instead of a hang.
 import os
 import subprocess
 import sys
+import time
 
 RUNNER = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "peritext_tpu", "bench", "run_bench.py"
 )
+
+
+def _last_json_line(stdout: str | bytes | None) -> str | None:
+    """The runner prints the headline line as soon as the throughput
+    measurement lands and a superseding line after the latency measurement;
+    the LAST matching line is the most complete one."""
+    if stdout is None:
+        return None
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    found = None
+    for line in stdout.splitlines():
+        if line.startswith("{") and '"metric"' in line:
+            found = line
+    return found
+
+
+def probe(timeout: float) -> bool:
+    """Tiny supervised device op on the default platform.
+
+    The relayed TPU wedges at device init when unhealthy; spending a couple
+    of minutes here (instead of the full attempt budget) preserves the CPU
+    fallback's time.  A probe subprocess that hangs is killed — it has not
+    started device execution, which is the dangerous point to interrupt.
+    """
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, numpy as np, jax.numpy as jnp;"
+                "print(float(np.asarray(jnp.ones((4,4)).sum())))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "16.0" in proc.stdout
 
 
 def attempt(platform: str | None, timeout: float) -> str | None:
@@ -36,33 +77,72 @@ def attempt(platform: str | None, timeout: float) -> str | None:
             text=True,
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # The runner may have printed the headline (throughput) line before
+        # hanging in a later phase — a wedged-relay kill must not discard a
+        # completed hardware measurement.
+        line = _last_json_line(e.stdout)
+        if line is not None:
+            print(
+                f"bench: attempt on {platform or 'default'} timed out after the "
+                "headline measurement; keeping the partial line",
+                file=sys.stderr,
+            )
+            return line
         print(f"bench: attempt on {platform or 'default'} timed out", file=sys.stderr)
         return None
+    line = _last_json_line(proc.stdout)
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr)
+        if line is not None:
+            print(
+                f"bench: attempt on {platform or 'default'} failed after the "
+                "headline measurement; keeping the partial line",
+                file=sys.stderr,
+            )
+            return line
         print(f"bench: attempt on {platform or 'default'} failed", file=sys.stderr)
         return None
-    for line in proc.stdout.splitlines():
-        if line.startswith("{") and '"metric"' in line:
-            return line
-    sys.stderr.write(proc.stderr)
-    return None
+    if line is None:
+        sys.stderr.write(proc.stderr)
+    return line
 
 
 def main() -> None:
-    # The default-platform attempt hits the TPU tunnel, which can wedge and
-    # hang at device init; give it its own (overridable) budget so a wedged
-    # tunnel can't eat the CPU fallback's time.  The budget covers several
-    # fresh XLA compiles (merge + latency shapes + a possible scan-path
-    # retry), so it errs generous — killing a healthy run mid-compile would
-    # lose the hardware number entirely.
-    line = attempt(
-        None,
-        timeout=float(
-            os.environ.get("BENCH_TPU_TIMEOUT", os.environ.get("BENCH_TIMEOUT", "1500"))
-        ),
+    # Fail fast on a wedged relay: a tiny probe decides whether the
+    # expensive default-platform attempt is worth starting at all.
+    # Compile-time pathologies are ruled out locally
+    # (scripts/aot_merge_compile_timing.py: every merge path compiles in
+    # ~1 min at bench shape), so a probe failure means the tunnel itself.
+    # The probe spends part of the SAME budget as the attempt (callers size
+    # BENCH_TPU_TIMEOUT against their outer supervision, and probe+attempt
+    # must fit inside it); BENCH_PROBE_TIMEOUT<=0 skips the probe for
+    # callers that just probed the relay themselves.
+    budget = float(
+        os.environ.get("BENCH_TPU_TIMEOUT", os.environ.get("BENCH_TIMEOUT", "1500"))
     )
+    probe_budget = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    probe_budget = min(probe_budget, budget / 2)
+    line = None
+    skip_attempt = False
+    if probe_budget > 0:
+        t0 = time.monotonic()
+        if not probe(timeout=probe_budget):
+            print(
+                "bench: default-platform probe failed (wedged relay?); "
+                "skipping straight to the CPU fallback",
+                file=sys.stderr,
+            )
+            skip_attempt = True
+        budget -= time.monotonic() - t0
+    if not skip_attempt:
+        # The default-platform attempt hits the TPU tunnel, which can wedge
+        # mid-run; give it its own (overridable) budget so a wedged tunnel
+        # can't eat the CPU fallback's time.  The budget covers several
+        # fresh XLA compiles (merge + latency shapes + a possible scan-path
+        # retry), so it errs generous — killing a healthy run mid-compile
+        # would lose the hardware number entirely.
+        line = attempt(None, timeout=budget)
     if line is None:
         # TPU tunnel unreachable or run failed: measure on CPU instead.
         line = attempt("cpu", timeout=float(os.environ.get("BENCH_TIMEOUT", "1500")))

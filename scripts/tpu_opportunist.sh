@@ -46,17 +46,17 @@ run_steps() {
   # bench.py's own supervision (not ours) does the killing and labels the
   # JSON honestly.  The scatter splice is the configuration of the round's
   # one successful hardware bench — it goes first.
-  step bench_scatter.json 2100 env PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 python3 bench.py || return 1
+  step bench_scatter.json 2100 env PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_sorted.json 2100 env BENCH_TPU_TIMEOUT=2000 python3 bench.py || return 1
+  step bench_sorted.json 2100 env BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_roll.json 2100 env PERITEXT_SPLICE=roll BENCH_TPU_TIMEOUT=2000 python3 bench.py || return 1
+  step bench_roll.json 2100 env PERITEXT_SPLICE=roll BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_pallas.json 2100 env BENCH_PALLAS=1 PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 python3 bench.py || return 1
+  step bench_pallas.json 2100 env BENCH_PALLAS=1 PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_scan.json 2100 env BENCH_PATH=scan BENCH_TPU_TIMEOUT=2000 python3 bench.py || return 1
+  step bench_scan.json 2100 env BENCH_PATH=scan BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_r4096.json 2100 env BENCH_REPLICAS=4096 PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 python3 bench.py || return 1
+  step bench_r4096.json 2100 env BENCH_REPLICAS=4096 PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
 
   # Pallas hardware differential, one test per process.
   probe || return 1
@@ -73,7 +73,7 @@ run_steps() {
   step config4.json 3600 python3 -m peritext_tpu.bench.configs --config 4 --platform ambient || return 1
   probe || return 1
   step bench_profiled.json 2100 env PERITEXT_PROFILE="$OUT/profile" \
-    PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_REPLICAS=1024 python3 bench.py || return 1
+    PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 BENCH_REPLICAS=1024 python3 bench.py || return 1
   return 0
 }
 
