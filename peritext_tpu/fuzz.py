@@ -105,6 +105,76 @@ def _random_delete(rng: random.Random, doc: Doc) -> Optional[Dict[str, Any]]:
     return {"path": ["text"], "action": "delete", "index": index, "count": count}
 
 
+# -- nested-object fuzzing (the host structural plane) -----------------------
+
+_NESTED_KEYS = ["k0", "k1", "k2", "list0", "list1"]
+
+
+def _discover_objects(root: Dict[str, Any]) -> Dict[str, List[List[str]]]:
+    """Walk a materialized root view for nested maps and lists (public
+    surface only, so the same discovery drives oracle Docs and TpuDocs).
+    The root text list is excluded — the classic generators own it.  Takes
+    the root snapshot rather than the doc: materializing ``doc.root`` on a
+    TpuDoc costs a device text readback, so callers snapshot once."""
+    maps: List[List[str]] = [[]]
+    lists: List[List[str]] = []
+
+    def walk(value: Dict[str, Any], path: List[str]) -> None:
+        for key, child in value.items():
+            if isinstance(child, dict):
+                maps.append(path + [key])
+                if len(path) < 2:
+                    walk(child, path + [key])
+            elif isinstance(child, list) and (path or key != "text"):
+                lists.append(path + [key])
+
+    walk(root, [])
+    return {"maps": maps, "lists": lists}
+
+
+def _random_structural(rng: random.Random, doc: Any) -> Optional[Dict[str, Any]]:
+    """One random op against the host structural plane: create/set/del on a
+    map, or insert/delete/mark on a nested list."""
+    root = doc.root
+    objs = _discover_objects(root)
+    kind = rng.choice(["makeMap", "makeList", "set", "del", "list_edit", "list_mark"])
+    if kind in ("makeMap", "makeList", "set", "del"):
+        path = rng.choice(objs["maps"])
+        key = rng.choice(_NESTED_KEYS)
+        if kind == "set":
+            return {"path": path, "action": "set", "key": key, "value": rng.randrange(100)}
+        if kind == "del":
+            return {"path": path, "action": "del", "key": key}
+        return {"path": path, "action": kind, "key": key}
+    if not objs["lists"]:
+        return None
+    path = rng.choice(objs["lists"])
+    # Resolve the list through the same root snapshot to bound indices.
+    node: Any = root
+    for p in path:
+        node = node[p]
+    length = len(node)
+    if kind == "list_edit":
+        if length and rng.random() < 0.4:
+            index = rng.randrange(length)
+            return {"path": path, "action": "delete", "index": index, "count": 1}
+        index = rng.randrange(length + 1) if length else 0
+        values = [rng.choice("uvwxyz") for _ in range(rng.randrange(2) + 1)]
+        return {"path": path, "action": "insert", "index": index, "values": values}
+    if length == 0:
+        return None
+    start = rng.randrange(length)
+    end = start + rng.randrange(length - start) + 1
+    mark_type = rng.choice(["strong", "em"])
+    return {
+        "path": path,
+        "action": rng.choice(["addMark", "removeMark"]),
+        "startIndex": start,
+        "endIndex": end,
+        "markType": mark_type,
+    }
+
+
 def fuzz(
     iterations: int = 200,
     seed: int = 0,
@@ -114,9 +184,22 @@ def fuzz(
     allow_comment_remove: bool = False,
     doc_factory: Callable[[str], Any] = Doc,
     check_patches: bool = True,
+    nested: bool = False,
 ) -> Dict[str, Any]:
-    """Run the fuzz loop; raises :class:`FuzzError` with a replayable state."""
+    """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
+
+    With ``nested``, a share of iterations drive the host structural plane
+    (nested makeMap/makeList/set/del, second-list edits and marks) and every
+    sync additionally asserts root-view and nested-list-span convergence.
+    Patch/batch checking is forced off in that mode: the reference hardcodes
+    ``path: ["text"]`` on every list patch (micromerge.ts:643), so a stream
+    mixing lists is inherently ambiguous to accumulate — a reference quirk,
+    not an engine gap (deterministic patch-interleaving coverage lives in
+    tests/test_nested_objects.py).
+    """
     rng = random.Random(seed)
+    if nested:
+        check_patches = False
     docs, all_patches, initial_change = generate_docs(initial_text, num_docs)
     if doc_factory is not Doc:
         # Rebuild replicas with the engine under test from the genesis change.
@@ -138,13 +221,18 @@ def fuzz(
     for _ in range(iterations):
         target = rng.randrange(len(docs))
         doc = docs[target]
-        op_kind = rng.choice(["insert", "remove", "addMark", "removeMark"])
+        kinds = ["insert", "remove", "addMark", "removeMark"]
+        if nested:
+            kinds += ["structural", "structural"]
+        op_kind = rng.choice(kinds)
         if op_kind == "insert":
             op = _random_insert(rng, doc, max_insert_chars)
         elif op_kind == "remove":
             op = _random_delete(rng, doc)
         elif op_kind == "addMark":
             op = _random_add_mark(rng, doc, comment_history)
+        elif op_kind == "structural":
+            op = _random_structural(rng, doc)
         else:
             op = _random_remove_mark(rng, doc, comment_history, allow_comment_remove)
         if op is None:
@@ -181,6 +269,24 @@ def fuzz(
             fail("clock divergence", {"left": dict(docs[left].clock), "right": dict(docs[right].clock)})
         if left_spans != right_spans:
             fail("span divergence", {"left": left_spans, "right": right_spans})
+        if nested:
+            left_root = docs[left].root
+            right_root = docs[right].root
+            if left_root != right_root:
+                fail(
+                    "root-view divergence",
+                    {"left": repr(left_root), "right": repr(right_root)},
+                )
+            # Marked nested lists: spans must agree too (marks are invisible
+            # in the plain root view).  Reuses the snapshot just compared.
+            for path in _discover_objects(left_root)["lists"]:
+                ls = docs[left].get_text_with_formatting(path)
+                rs = docs[right].get_text_with_formatting(path)
+                if ls != rs:
+                    fail(
+                        f"nested span divergence at {path}",
+                        {"left": ls, "right": rs},
+                    )
 
     return {
         "docs": docs,

@@ -19,6 +19,14 @@ def test_fuzz_larger_doc():
     fuzz(iterations=100, seed=5, initial_text="The quick brown fox", max_insert_chars=4)
 
 
+@pytest.mark.parametrize("seed", [0, 7, 21])
+def test_fuzz_nested_objects_converges(seed):
+    """Randomized host-structural-plane coverage: nested makeMap/makeList,
+    map set/del LWW races, second-list edits and marks, with root-view and
+    nested-span convergence asserted at every sync."""
+    fuzz(iterations=150, seed=seed, nested=True)
+
+
 def test_fuzz_failure_states_replay(tmp_path):
     """The failure-observability loop: a FuzzError's saved state is a
     replayable change-log trace (the reference's traces/*.json contract)."""

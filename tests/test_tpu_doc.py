@@ -188,3 +188,21 @@ def test_fuzz_mixed_engines():
         return next(engines)(actor_id)
 
     fuzz(iterations=40, seed=3, doc_factory=factory, initial_text="ABCDE")
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_fuzz_engine_nested_objects(seed):
+    """Nested-object fuzz on TpuDoc replicas: the host structural plane and
+    the device text plane exercised together under randomized schedules."""
+    fuzz(iterations=40, seed=seed, doc_factory=TpuDoc, nested=True)
+
+
+def test_fuzz_mixed_engines_nested_objects():
+    """Oracle and TpuDoc replicas racing nested-object ops in one group —
+    the strongest differential for the host structural plane."""
+    engines = iter([TpuDoc, Doc, TpuDoc])
+
+    def factory(actor_id):
+        return next(engines)(actor_id)
+
+    fuzz(iterations=40, seed=9, doc_factory=factory, nested=True)
