@@ -19,10 +19,12 @@ pytestmark = pytest.mark.skipif(
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_all_pallas_kernels_compile_under_mosaic():
+def _run_aot(script: str, *args: str) -> subprocess.CompletedProcess:
+    """Run an AOT-compile script in a clean subprocess (strip the conftest's
+    XLA_FLAGS; the script pins its own platform before first backend use)."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "aot_compile_check.py")],
+        [sys.executable, os.path.join(REPO, "scripts", script), *args],
         capture_output=True,
         text=True,
         timeout=900,
@@ -30,5 +32,20 @@ def test_all_pallas_kernels_compile_under_mosaic():
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+def test_all_pallas_kernels_compile_under_mosaic():
+    proc = _run_aot("aot_compile_check.py")
     for name in ("text", "mark", "full"):
         assert f"mosaic aot compile ok: {name}" in proc.stdout
+
+
+@pytest.mark.parametrize("path", ["sort", "scatter", "roll", "scan"])
+def test_merge_paths_compile_for_tpu(path):
+    """Every production merge path must compile with the real XLA:TPU
+    compiler (local libtpu, abstract v5e — no relay).  CPU jit coverage in
+    the regular suite can't catch TPU-only lowering breaks (sort/scatter
+    lowerings differ per backend); this can, in ~1 min per path."""
+    proc = _run_aot("aot_merge_compile_timing.py", path)
+    assert f"aot[{path}]:" in proc.stdout

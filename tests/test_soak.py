@@ -1,9 +1,10 @@
-"""Randomized multi-session soak of the production (sorted) merge path.
+"""Randomized soak suites (opt-in, a few minutes: PERITEXT_SLOW=1).
 
-40 sessions x up to 4 replicas x random concurrent op streams, each
-cross-applied in per-replica shuffled interleavings: engine spans must
-equal the oracle's everywhere and digests must agree.  Opt-in (a few
-minutes): PERITEXT_SLOW=1 pytest tests/test_soak.py
+- Sorted-path soak: 40 sessions x up to 4 replicas x random concurrent op
+  streams, each cross-applied in per-replica shuffled interleavings; engine
+  spans must equal the oracle's everywhere and digests must agree.
+- Nested-object soak: 10 sessions of 250-iteration mixed-engine fuzz
+  (oracle + TpuDoc) racing structural ops on the host plane.
 """
 import os
 import random
@@ -69,3 +70,20 @@ def test_sorted_path_soak_session(seed):
         )
     digests = uni.digests()
     assert (digests == digests[0]).all(), f"seed {seed} digests diverged"
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_nested_objects_soak_session(seed):
+    """Long mixed-engine nested-object fuzz: oracle and TpuDoc replicas
+    racing structural ops (nested maps/lists, LWW key churn, second-list
+    marks) over hundreds of iterations per session."""
+    from peritext_tpu.fuzz import fuzz
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.ops import TpuDoc
+
+    engines = iter([TpuDoc, Doc, TpuDoc])
+
+    def factory(actor_id):
+        return next(engines)(actor_id)
+
+    fuzz(iterations=250, seed=3000 + seed, doc_factory=factory, nested=True)
