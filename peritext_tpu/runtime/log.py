@@ -120,9 +120,16 @@ class ChangeLog:
                 header = {k: change[k] for k in ("actor", "seq", "deps", "startOp")}
                 ops_meta: List[Any] = []
                 for op in change["ops"]:
-                    row = encode_internal_op(op, actors, attrs)
+                    try:
+                        row = encode_internal_op(op, actors, attrs)
+                    except ValueError:
+                        # Host-list op with a value the char plane can't
+                        # carry (e.g. a multi-codepoint element in a nested
+                        # list — legal in the object model): envelope JSON,
+                        # like structural ops.
+                        row = None
                     if row is None:
-                        ops_meta.append(op)  # structural op: raw JSON
+                        ops_meta.append(op)  # structural / unencodable: raw JSON
                     else:
                         ops_meta.append(None)  # device op: row stream
                         rows.append(row)

@@ -61,3 +61,35 @@ def test_change_log_binary_round_trip():
     # the JSON header envelope dominates small logs like this one).
     as_json = json.dumps({a: log.changes_for(a) for a in log.actors}).encode()
     assert len(data) < len(as_json) * 0.75, (len(data), len(as_json))
+
+
+def test_change_log_round_trips_nested_object_changes():
+    """Logs holding structural ops and host-list ops round-trip: nested-list
+    inserts ride the binary row stream (obj table restores their target),
+    and values the char plane can't encode (multi-codepoint elements —
+    legal in the object model) fall back to the JSON envelope."""
+    result = fuzz(iterations=80, seed=4, nested=True)
+    log = result["log"]
+
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.runtime.sync import apply_changes
+
+    observer = Doc("observer")
+    apply_changes(observer, log.all_changes())
+    # A nested list holding a multi-char element (one op, one element).
+    weird, _ = observer.change(
+        [
+            {"path": [], "action": "makeList", "key": "wide"},
+            {"path": ["wide"], "action": "insert", "index": 0, "values": ["ab", "c"]},
+        ]
+    )
+    log.record(weird)
+
+    restored = ChangeLog.from_bytes(log.to_bytes())
+    for actor in log.actors:
+        assert restored.changes_for(actor) == log.changes_for(actor), actor
+    # The restored log replays into a converged replica, wide list intact.
+    replica = Doc("replay")
+    apply_changes(replica, restored.all_changes())
+    assert replica.root == observer.root
+    assert replica.root["wide"] == ["ab", "c"]
