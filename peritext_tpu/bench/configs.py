@@ -294,7 +294,31 @@ def main() -> None:
         "bench.py guards with a supervised subprocess; pass 'ambient' to "
         "use whatever the environment provides, e.g. the real TPU)",
     )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=0,
+        help="supervise the run in a child process and kill it after this "
+        "many seconds (the bench.py pattern: we do the killing on our own "
+        "schedule and label the result, instead of an external timeout(1) "
+        "SIGTERM landing mid-TPU-execution); 0 runs in-process",
+    )
     args = parser.parse_args()
+    if args.timeout > 0:
+        import subprocess
+        import sys
+
+        cmd = [
+            sys.executable, "-m", "peritext_tpu.bench.configs",
+            "--config", str(args.config), "--platform", args.platform,
+        ]
+        try:
+            proc = subprocess.run(cmd, timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"config": args.config, "timed_out": True,
+                              "timeout_s": args.timeout}))
+            raise SystemExit(1)
+        raise SystemExit(proc.returncode)
     if args.platform != "ambient":
         import jax
 

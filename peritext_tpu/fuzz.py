@@ -42,8 +42,19 @@ class FuzzError(AssertionError):
             json.dump(self.state, f)
 
 
+def _text_len(doc: Any) -> int:
+    """Visible length of the list at path ["text"] — robust to the root
+    'text' key being LWW-overwritten with a plain value (the path still
+    resolves through the unpruned ``children`` entry, micromerge.ts:592-600,
+    so list ops keep targeting the original device list)."""
+    t = doc.root.get("text")
+    if isinstance(t, list):
+        return len(t)
+    return sum(len(s["text"]) for s in doc.get_text_with_formatting(["text"]))
+
+
 def _random_add_mark(rng: random.Random, doc: Doc, comment_history: List[str]) -> Dict[str, Any]:
-    length = len(doc.root["text"])
+    length = _text_len(doc)
     start = rng.randrange(length)
     end = start + rng.randrange(length - start) + 1
     mark_type = rng.choice(MARK_TYPES)
@@ -66,7 +77,7 @@ def _random_add_mark(rng: random.Random, doc: Doc, comment_history: List[str]) -
 def _random_remove_mark(
     rng: random.Random, doc: Doc, comment_history: List[str], allow_comment_remove: bool
 ) -> Dict[str, Any]:
-    length = len(doc.root["text"])
+    length = _text_len(doc)
     start = rng.randrange(length)
     end = start + rng.randrange(length - start) + 1
     choices = [t for t in MARK_TYPES if allow_comment_remove or t != "comment"]
@@ -87,7 +98,7 @@ def _random_remove_mark(
 
 
 def _random_insert(rng: random.Random, doc: Doc, max_chars: int) -> Optional[Dict[str, Any]]:
-    length = len(doc.root["text"])
+    length = _text_len(doc)
     index = rng.randrange(length) if length else 0
     num = rng.randrange(max_chars)
     values = [rng.choice("0123456789abcdef") for _ in range(num)]
@@ -95,7 +106,7 @@ def _random_insert(rng: random.Random, doc: Doc, max_chars: int) -> Optional[Dic
 
 
 def _random_delete(rng: random.Random, doc: Doc) -> Optional[Dict[str, Any]]:
-    length = len(doc.root["text"])
+    length = _text_len(doc)
     # Faithful to the reference's bounds (fuzz.ts:128-129), which never
     # delete the entire document (a noted real bug when you do).
     index = rng.randrange(length) + 1
@@ -140,7 +151,13 @@ def _random_structural(rng: random.Random, doc: Any) -> Optional[Dict[str, Any]]
     kind = rng.choice(["makeMap", "makeList", "set", "del", "list_edit", "list_mark"])
     if kind in ("makeMap", "makeList", "set", "del"):
         path = rng.choice(objs["maps"])
-        key = rng.choice(_NESTED_KEYS)
+        keys = _NESTED_KEYS
+        if kind in ("set", "del"):
+            # Include 'text' so set/del races the device binding on the root
+            # map — exactly where a stale root-view gate would hide (the
+            # generators above stay robust via _text_len).
+            keys = _NESTED_KEYS + ["text"]
+        key = rng.choice(keys)
         if kind == "set":
             return {"path": path, "action": "set", "key": key, "value": rng.randrange(100)}
         if kind == "del":

@@ -67,7 +67,15 @@ class TpuDoc:
         root = dict(store.objects[ROOT])
         children = store.metadata[ROOT].children
         text_obj = self._text_obj()
-        if text_obj is not None and children.get("text") == text_obj:
+        # Materialize device text only while the root key still holds the
+        # bound list's placeholder (ObjectStore.is_linked — ``children`` is
+        # never pruned on LWW set-overwrite or del, so the children check
+        # alone would keep showing device text after a winning set/del).
+        if (
+            text_obj is not None
+            and children.get("text") == text_obj
+            and store.is_linked(ROOT, "text")
+        ):
             root["text"] = list(self._uni.text(0))
         return root
 

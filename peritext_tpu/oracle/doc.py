@@ -592,6 +592,26 @@ class ObjectStore:
                 visible += 1
         raise KeyError(f"List element not found: {elem_id}")
 
+    def is_linked(self, obj_id: Optional[str], key: str) -> bool:
+        """True while ``key`` in map ``obj_id`` still holds its bound child
+        object.  ``children`` entries outlive del/LWW-overwrite (the
+        reference never prunes them, micromerge.ts:592-600), so every view
+        that materializes a child through ``children`` must gate on the
+        *live* map value — this is that single shared predicate (used by
+        the snapshot serializer below and TpuDoc.root)."""
+        meta = self.metadata.get(obj_id)
+        if not isinstance(meta, MapMeta):
+            return False
+        cid = meta.children.get(key)
+        if cid is None:
+            return False
+        obj = self.objects.get(obj_id)
+        return (
+            isinstance(obj, dict)
+            and key in obj
+            and obj[key] is self.objects.get(cid)
+        )
+
     # -- snapshot serialization (runtime/checkpoint.py sidecars) ------------
 
     def to_json(self) -> Dict[str, Any]:
@@ -630,9 +650,7 @@ class ObjectStore:
                 # only those re-link on load; a deleted key must not
                 # resurrect and an overwritten one keeps its plain value.
                 linked = sorted(
-                    k
-                    for k, cid in meta.children.items()
-                    if k in obj and obj[k] is self.objects.get(cid)
+                    k for k in meta.children if self.is_linked(obj_id, k)
                 )
                 objects[key] = {
                     "type": "map",

@@ -42,24 +42,17 @@ step() {
 }
 
 run_steps() {
-  # Most-valuable-first; BENCH_TPU_TIMEOUT slightly under the step budget so
-  # bench.py's own supervision (not ours) does the killing and labels the
-  # JSON honestly.  The scatter splice is the configuration of the round's
-  # one successful hardware bench — it goes first.
-  step bench_scatter.json 2100 env PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
-  probe || return 1
+  # Round-3 priority order (VERDICT items 1-4, 6).  BENCH_TPU_TIMEOUT
+  # slightly under the step budget so bench.py's own supervision (not ours)
+  # does the killing and labels the JSON honestly.
+  # 1. The headline driver-contract bench, default (sorted) path.
   step bench_sorted.json 2100 env BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_roll.json 2100 env PERITEXT_SPLICE=roll BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
+  # 2. Profile capture for the roofline (VERDICT item 2).
+  step bench_profiled.json 2100 env PERITEXT_PROFILE="$OUT/profile" \
+    BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 BENCH_REPLICAS=1024 python3 bench.py || return 1
   probe || return 1
-  step bench_pallas.json 2100 env BENCH_PALLAS=1 PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
-  probe || return 1
-  step bench_scan.json 2100 env BENCH_PATH=scan BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
-  probe || return 1
-  step bench_r4096.json 2100 env BENCH_REPLICAS=4096 PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
-
-  # Pallas hardware differential, one test per process.
-  probe || return 1
+  # 3. Pallas hardware numerics (VERDICT item 4), one test per process.
   step pallas_collect.txt 300 env PERITEXT_TEST_PLATFORM=cpu \
     python3 -m pytest tests/test_pallas.py --collect-only -q || return 1
   local i=0 t
@@ -69,11 +62,24 @@ run_steps() {
     probe || return 1
     i=$((i + 1))
   done
-
-  step config4.json 3600 python3 -m peritext_tpu.bench.configs --config 4 --platform ambient || return 1
+  # 4. Pallas vs sorted A/B at the bench shape (VERDICT item 4).
+  step bench_pallas.json 2100 env BENCH_PALLAS=1 BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
-  step bench_profiled.json 2100 env PERITEXT_PROFILE="$OUT/profile" \
-    PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 BENCH_REPLICAS=1024 python3 bench.py || return 1
+  # 5. Splice strategy A/B on hardware.
+  step bench_scatter.json 2100 env PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
+  probe || return 1
+  step bench_roll.json 2100 env PERITEXT_SPLICE=roll BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
+  probe || return 1
+  # 6. Configs 3-5 at TPU scale (VERDICT item 6).  --timeout keeps the kill
+  # on the configs runner's own schedule (labeled JSON, child-process kill)
+  # instead of our outer timeout SIGTERMing mid-TPU-execution.
+  step config3.json 2100 python3 -m peritext_tpu.bench.configs --config 3 --platform ambient --timeout 2000 || return 1
+  probe || return 1
+  step config4.json 3600 python3 -m peritext_tpu.bench.configs --config 4 --platform ambient --timeout 3500 || return 1
+  probe || return 1
+  step config5.json 3600 python3 -m peritext_tpu.bench.configs --config 5 --platform ambient --timeout 3500 || return 1
+  probe || return 1
+  step bench_r4096.json 2100 env BENCH_REPLICAS=4096 BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   return 0
 }
 

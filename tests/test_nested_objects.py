@@ -401,3 +401,59 @@ def test_unknown_nested_path_raises():
         shadow.change([{"path": ["nope"], "action": "insert", "index": 0, "values": ["x"]}])
     with pytest.raises(KeyError):
         tpu.change([{"path": ["nope"], "action": "insert", "index": 0, "values": ["x"]}])
+
+
+def test_root_text_overwrite_and_delete_update_root_view():
+    """A winning set/del on the root 'text' key must change TpuDoc.root the
+    same way it changes Doc.root.  ``children`` is never pruned on LWW
+    overwrite or del (reference-faithful, micromerge.ts:592-600), so the
+    root view gates on the *live* map value, not the children entry.
+    List ops at path ["text"] keep working throughout: the path resolves
+    through the unpruned children entry, exactly like the reference."""
+    oracle, tpu, shadow, _ = seeded()
+
+    change, _ = oracle.change([{"path": [], "action": "set", "key": "text", "value": 42}])
+    tpu.apply_change(change)
+    shadow.apply_change(change)
+    assert shadow.root == {"text": 42}
+    assert tpu.root == shadow.root
+    # Device plane still serves the (unpruned) path, same as the oracle.
+    assert tpu.get_text_with_formatting(["text"]) == shadow.get_text_with_formatting(["text"])
+
+    change2, _ = oracle.change([{"path": [], "action": "del", "key": "text"}])
+    tpu.apply_change(change2)
+    shadow.apply_change(change2)
+    assert shadow.root == {}
+    assert tpu.root == shadow.root
+    assert tpu.get_text_with_formatting(["text"]) == shadow.get_text_with_formatting(["text"])
+
+    # Edits through the (still-resolvable) path stay convergent and visible
+    # to both engines even while the root view hides the key.
+    ins, _ = oracle.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["z"]}]
+    )
+    tpu.apply_change(ins)
+    shadow.apply_change(ins)
+    assert tpu.get_text_with_formatting(["text"]) == shadow.get_text_with_formatting(["text"])
+    assert tpu.root == shadow.root == {}
+
+
+def test_losing_root_text_overwrite_keeps_device_view():
+    """A *losing* concurrent set on 'text' must not clobber the device text
+    in either engine's root view (LWW by op id, micromerge.ts:578-602)."""
+    oracle, tpu, shadow, genesis = seeded()
+    # Build a loser: an actor whose set op has a LOWER opId than the
+    # genesis makeList.  Genesis startOp is 1 (makeList) and the inserts
+    # push maxOp higher, so a fresh actor's eager first op (counter 1)
+    # loses to nothing... instead craft the change manually with counter 1.
+    loser = {
+        "actor": "aaa",
+        "seq": 1,
+        "deps": {},
+        "startOp": 1,
+        "ops": [{"opId": "1@aaa", "action": "set", "obj": None, "key": "text", "value": 7}],
+    }
+    tpu.apply_change(loser)
+    shadow.apply_change(loser)
+    assert shadow.root["text"] == list("Hello")
+    assert tpu.root == shadow.root
