@@ -93,6 +93,26 @@ def main() -> None:
         print(json.dumps(result))
         sys.stdout.flush()
 
+    # Opt-in third metric: the PATCH-EMITTING ingest path (what an editor
+    # fleet consumes), end-to-end through the universe API.  BENCH_PATCHES=1
+    # adds it; =ab also measures the interleaved-scan fallback for the A/B.
+    patches_mode = os.environ.get("BENCH_PATCHES")
+    if patches_mode:
+        try:
+            from peritext_tpu.bench.workloads import time_patched_merge
+
+            p = time_patched_merge()
+            result["patched_ops_per_sec"] = round(p["ops_per_sec"], 1)
+            result["patched_replicas"] = p["replicas"]
+            result["patched_path"] = p["path"]
+            if patches_mode == "ab":
+                p_scan = time_patched_merge(force_scan=True)
+                result["patched_scan_ops_per_sec"] = round(p_scan["ops_per_sec"], 1)
+            print(json.dumps(result))
+            sys.stdout.flush()
+        except Exception as err:
+            print(f"bench: patched measurement failed: {err}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))

@@ -1235,6 +1235,345 @@ def merge_step_sorted_batch(
     return jax.tree.map(lambda *xs: jnp.concatenate(xs), *outs)
 
 
+# ---------------------------------------------------------------------------
+# Patch-emitting sorted merge: analytic text records + mark-only scan
+# ---------------------------------------------------------------------------
+#
+# The faithful patch stream is a deterministic function of (pre-batch state,
+# delivery-ordered op list).  The sorted placement gives every element's
+# FINAL position up front; each op's patch context is then reconstructed
+# from a timeline in final coordinates: born[p] / died[p] are the
+# batch-stream instants the element at final position p appeared / was
+# first tombstoned (+/-_TIME_BIG for pre-batch facts), so "visible at op
+# i's instant" is the closed predicate born < t_i < died.  Insert/delete
+# patch records (visible index, validity, inherited-mark source slot)
+# become vectorized counting over that predicate — no per-op scan.  Mark
+# ops still scan (their patch signals read evolving boundary sets), but
+# only over the batch's MARK rows, on final coordinates with per-step time
+# masks; a text-dominated batch (typing) no longer pays one sequential
+# step per character.  Delivery-order fidelity needs run fusion gated on
+# stream adjacency (encode.fuse_insert_runs with ``pos``): a fused run is
+# modeled as k consecutive instants, which is exactly true only when no
+# other op interleaves the chars in the delivery stream.
+
+_TIME_BIG = 1 << 30
+
+
+def _sorted_text_records(
+    elem_ctr, elem_act, orig_idx, length, pre_deleted0,
+    text_ops, text_time, mark_time, mark_valid,
+):
+    """Per-text-row patch records from the final placement + timeline.
+
+    Returns (born, died, q, index0, tvalid, tm) where born/died are the
+    [C] timeline arrays, q is each row's target's final position, index0
+    the reference walk's visibleIndex at the row's instant
+    (micromerge.ts:659 for inserts / 677-699 for deletes), tvalid the
+    delete-idempotence validity, and tm the count of mark ops applied
+    before the row's instant (its boundary-plane version).
+    """
+    c = elem_ctr.shape[0]
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < length
+    pre = orig_idx >= 0
+    pre_del = pre & pre_deleted0[jnp.maximum(orig_idx, 0)]
+
+    kind = text_ops[:, K_KIND]
+    is_ins = (kind == KIND_INSERT) | (kind == KIND_INSERT_RUN)
+    is_del = kind == KIND_DELETE
+    is_run = kind == KIND_INSERT_RUN
+    ctr_l = text_ops[:, K_CTR]
+    act_l = text_ops[:, K_ACT]
+    k = jnp.where(is_run, text_ops[:, K_RUN_LEN], 1) * is_ins.astype(jnp.int32)
+    t = text_time
+
+    # born[p]: each batch-born element matches exactly one insert row; char
+    # j of a run appeared at instant t + j (delivery-adjacent by fusion
+    # gating).  Pre-batch elements: -BIG.
+    created = (
+        is_ins[:, None]
+        & (elem_act[None, :] == act_l[:, None])
+        & (elem_ctr[None, :] >= ctr_l[:, None])
+        & (elem_ctr[None, :] < (ctr_l + k)[:, None])
+    )  # [L, C]
+    born_batch = jnp.sum(
+        jnp.where(created, t[:, None] + (elem_ctr[None, :] - ctr_l[:, None]), 0),
+        axis=0,
+    )
+    in_batch = created.any(axis=0)
+    born = jnp.where(
+        pre | ~in_batch, jnp.int32(-_TIME_BIG), born_batch.astype(jnp.int32)
+    )
+
+    # died[p]: first tombstoning instant (idempotent deletes: min).
+    del_match = (
+        is_del[:, None]
+        & (elem_ctr[None, :] == text_ops[:, K_REF_CTR, None])
+        & (elem_act[None, :] == text_ops[:, K_REF_ACT, None])
+    )
+    died_batch = jnp.min(
+        jnp.where(del_match, t[:, None], jnp.int32(_TIME_BIG)), axis=0
+    )
+    died = jnp.where(pre_del, jnp.int32(-_TIME_BIG), died_batch)
+
+    # Each row's target element's final position.
+    tgt_ctr = jnp.where(is_del, text_ops[:, K_REF_CTR], ctr_l)
+    tgt_act = jnp.where(is_del, text_ops[:, K_REF_ACT], act_l)
+    tmatch = (
+        live[None, :]
+        & (elem_ctr[None, :] == tgt_ctr[:, None])
+        & (elem_act[None, :] == tgt_act[:, None])
+    )
+    exists = jnp.any(tmatch, axis=1)
+    q = jnp.argmax(tmatch, axis=1).astype(jnp.int32)
+
+    # visibleIndex at the row's instant: elements final-ordered before the
+    # target that had appeared and not yet been tombstoned.  (Relative
+    # order of coexisting elements never changes, so final-order counting
+    # equals the walk's position at that time.)
+    alive = live[None, :] & (born[None, :] < t[:, None]) & (died[None, :] > t[:, None])
+    index0 = jnp.sum(alive & (ar[None, :] < q[:, None]), axis=1).astype(jnp.int32)
+
+    tvalid = jnp.where(is_del, exists & (born[q] < t) & (died[q] == t), is_ins)
+    tm = jnp.sum(
+        mark_valid[None, :] & (mark_time[None, :] < t[:, None]), axis=1
+    ).astype(jnp.int32)
+    return born, died, q, index0, tvalid, tm
+
+
+def _sorted_def_first(bnd_def0, mark_ops, elem_ctr, elem_act, length):
+    """First-definition mark index per boundary slot: -1 for pre-batch
+    defined slots, else the first mark row anchoring (start/end-writing) the
+    slot, else a sentinel beyond every instant.  Interior in-range writes
+    can never *first*-define a slot (they require it defined already,
+    peritext.ts:243-247), so anchor writes are the whole story — and anchor
+    resolution is time-independent, making this fully analytic."""
+    m_ops = mark_ops.shape[0]
+    c = elem_ctr.shape[0]
+    two_c = 2 * c
+    big = jnp.int32(two_c + 2)
+    midx = jnp.arange(m_ops, dtype=jnp.int32)
+    slots = jnp.arange(two_c, dtype=jnp.int32)
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < length
+
+    valid = mark_ops[:, K_KIND] == KIND_MARK
+    s_match = (
+        live[None, :]
+        & (elem_ctr[None, :] == mark_ops[:, K_SCTR, None])
+        & (elem_act[None, :] == mark_ops[:, K_SACT, None])
+    )
+    s_slot = 2 * jnp.argmax(s_match, axis=1).astype(jnp.int32) + mark_ops[:, K_SKIND]
+    e_match = (
+        live[None, :]
+        & (elem_ctr[None, :] == mark_ops[:, K_ECTR, None])
+        & (elem_act[None, :] == mark_ops[:, K_EACT, None])
+    )
+    e_slot = jnp.where(
+        mark_ops[:, K_EKIND] == 2,
+        big,
+        2 * jnp.argmax(e_match, axis=1).astype(jnp.int32)
+        + jnp.minimum(mark_ops[:, K_EKIND], 1),
+    )
+    e_slot = jnp.where(e_slot == s_slot, big, e_slot)
+
+    WS = (valid & (s_slot < e_slot))[:, None] & (slots[None, :] == s_slot[:, None])
+    WE = (valid & (e_slot < two_c))[:, None] & (slots[None, :] == e_slot[:, None])
+    first = jnp.min(jnp.where(WS | WE, midx[:, None], jnp.int32(m_ops + 1)), axis=0)
+    return jnp.where(bnd_def0, jnp.int32(-1), first)
+
+
+def merge_step_sorted_patched(
+    state: DocState,
+    text_ops: jax.Array,
+    round_of: jax.Array,
+    num_rounds: jax.Array,
+    mark_ops: jax.Array,
+    ranks: jax.Array,
+    char_buf: jax.Array,
+    multi: jax.Array,
+    text_time: jax.Array,
+    mark_time: jax.Array,
+    maxk: int,
+):
+    """Sorted merge that also emits per-op patch records.
+
+    Produces the exact interleaved-path records (apply_ops_patched) for the
+    same delivery order — differential bar: byte-identical assembled Patch
+    streams (tests/test_engine_patches, tests/test_sorted_merge) — while
+    the text phase runs in O(depth) placement rounds and the scan covers
+    only the batch's mark rows.  ``text_time`` / ``mark_time`` are each
+    row's flat delivery-stream position (encode row_pos; a fused run's
+    first char), padded with a beyond-any-instant sentinel.
+    """
+    elem_ctr, elem_act, deleted, chars, orig_idx, length = place_text_batch(
+        state.elem_ctr,
+        state.elem_act,
+        state.deleted,
+        state.chars,
+        state.length,
+        text_ops,
+        round_of,
+        num_rounds,
+        ranks,
+        char_buf,
+        maxk,
+    )
+    bnd_def0, bnd_mask0 = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+    mark_valid = mark_ops[:, K_KIND] == KIND_MARK
+    born, died, q, index0, tvalid, tm = _sorted_text_records(
+        elem_ctr, elem_act, orig_idx, length, state.deleted,
+        text_ops, text_time, mark_time, mark_valid,
+    )
+
+    # Inherited-marks source per insert row (getActiveMarksAtIndex,
+    # peritext.ts:328-330): nearest slot left of the insertion gap that is
+    # defined at the row's instant.  All chars of a delivery-adjacent run
+    # share it (the run's own fresh slots are undefined).
+    c = elem_ctr.shape[0]
+    slots = jnp.arange(2 * c, dtype=jnp.int32)
+    def_first = _sorted_def_first(bnd_def0, mark_ops, elem_ctr, elem_act, length)
+    kind_t = text_ops[:, K_KIND]
+    is_ins = (kind_t == KIND_INSERT) | (kind_t == KIND_INSERT_RUN)
+    src = jnp.max(
+        jnp.where(
+            (def_first[None, :] < tm[:, None]) & (slots[None, :] < 2 * q[:, None]),
+            slots[None, :],
+            jnp.int32(-1),
+        ),
+        axis=1,
+    )
+    src_ok = (src >= 0) & is_ins
+    src_c = jnp.maximum(src, 0)
+
+    # Mark table appended up front: the scan resolves winners against final
+    # columns, with per-step mark_count restricting candidates to ops
+    # already applied (present bits can't contain later ops anyway).
+    mark_cols = _append_mark_table(
+        (state.mark_ctr, state.mark_act, state.mark_action, state.mark_type, state.mark_attr),
+        mark_ops,
+        state.mark_count,
+        state.max_mark_ops,
+    )
+    mark_ctr_f, mark_act_f, mark_action_f, mark_type_f, mark_attr_f, mark_count_f = mark_cols
+
+    w = state.bnd_mask.shape[-1]
+    acc0 = jnp.zeros((text_ops.shape[0], w), jnp.uint32)
+    m_idx0 = jnp.arange(mark_ops.shape[0], dtype=jnp.int32)
+
+    def step(carry, xs):
+        bnd_def, bnd_mask, acc = carry
+        op, m_idx, t_m = xs
+        # Inserts whose instant lands at this plane version read their
+        # inherited row before this mark writes.  (Valid mark rows are a
+        # prefix; pad steps leave the planes untouched, so any tm landing
+        # on a pad index still reads the right version.)
+        rows = bnd_mask[src_c]  # [Lt, W]
+        take = src_ok & (tm == m_idx)
+        acc = acc | jnp.where(take[:, None], rows, jnp.uint32(0))
+
+        # Faithful per-op signals + application on a synthetic state view:
+        # final text plane with visibility masked to this instant, evolving
+        # boundary planes, final mark table truncated by mark_count.
+        st = DocState(
+            elem_ctr=elem_ctr,
+            elem_act=elem_act,
+            deleted=~((born < t_m) & (died > t_m)),
+            chars=chars,
+            bnd_def=bnd_def,
+            bnd_mask=bnd_mask,
+            mark_ctr=mark_ctr_f,
+            mark_act=mark_act_f,
+            mark_action=mark_action_f,
+            mark_type=mark_type_f,
+            mark_attr=mark_attr_f,
+            length=length,
+            mark_count=state.mark_count + m_idx,
+        )
+        valid = op[K_KIND] == KIND_MARK
+        written, during, changed, vis, final_vis = _mark_patch_signals(
+            st, op, ranks, multi
+        )
+        new_st = _apply_mark(st, op, ranks)
+        bnd_def = jnp.where(valid, new_st.bnd_def, bnd_def)
+        bnd_mask = jnp.where(valid, new_st.bnd_mask, bnd_mask)
+        rec = {
+            "written": written & valid,
+            "during": during & valid,
+            "changed": changed & valid,
+            "vis": vis,
+            "obj_len": final_vis,
+        }
+        return (bnd_def, bnd_mask, acc), rec
+
+    (bnd_def, bnd_mask, acc), mrec = lax.scan(
+        step, (bnd_def0, bnd_mask0, acc0), (mark_ops, m_idx0, mark_time)
+    )
+    # Inserts after every mark instant read the final planes.
+    rows = bnd_mask[src_c]
+    take = src_ok & (tm == mark_ops.shape[0])
+    ins_mask = acc | jnp.where(take[:, None], rows, jnp.uint32(0))
+
+    new_state = DocState(
+        elem_ctr=elem_ctr,
+        elem_act=elem_act,
+        deleted=deleted,
+        chars=chars,
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=mark_ctr_f,
+        mark_act=mark_act_f,
+        mark_action=mark_action_f,
+        mark_type=mark_type_f,
+        mark_attr=mark_attr_f,
+        length=length,
+        mark_count=mark_count_f,
+    )
+    records = {
+        "kind": kind_t,
+        "tvalid": tvalid,
+        "index0": index0,
+        "ins_mask": ins_mask,
+        "written": mrec["written"],
+        "during": mrec["during"],
+        "changed": mrec["changed"],
+        "vis": mrec["vis"],
+        "obj_len": mrec["obj_len"],
+    }
+    return new_state, records
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_step_sorted_patched_batch(maxk: int):
+    return jax.jit(
+        jax.vmap(
+            functools.partial(merge_step_sorted_patched, maxk=maxk),
+            in_axes=(0, 0, 0, None, 0, None, 0, None, 0, 0),
+        )
+    )
+
+
+def merge_step_sorted_patched_batch(
+    states,
+    text_ops,
+    round_of,
+    num_rounds,
+    mark_ops,
+    ranks,
+    char_buf,
+    multi,
+    text_time,
+    mark_time,
+    maxk: int,
+):
+    """Jitted batched entry point for the patch-emitting sorted merge."""
+    fn = _merge_step_sorted_patched_batch(maxk)
+    return fn(
+        states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks,
+        char_buf, multi, text_time, mark_time,
+    )
+
+
 def flatten_sources(state: DocState):
     """Per-element effective boundary bitset, for materialization.
 

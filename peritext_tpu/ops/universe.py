@@ -30,6 +30,7 @@ from peritext_tpu.ids import ActorRegistry, make_op_id
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.encode import (
     AttrRegistry,
+    TIME_PAD,
     bucket_length,
     encode_changes,
     pad_rows,
@@ -127,33 +128,32 @@ def assemble_patches(
     return patches
 
 
-def assemble_mark_patches(
-    records: Dict[str, np.ndarray],
-    r: int,
-    i: int,
+def _mark_patch_list(
+    written: np.ndarray,
+    during: np.ndarray,
+    changed: np.ndarray,
+    vis: np.ndarray,
+    obj_len: int,
     op_row: np.ndarray,
     attrs: AttrRegistry,
 ) -> List[Dict[str, Any]]:
     """Reference peritext.ts:198-221: a patch opens at every written DURING
     slot whose effective marks change, and closes at the next written slot
-    (or the end of the walk)."""
-    written = np.flatnonzero(records["written"][r, i])
-    if written.size == 0:
+    (or the end of the walk).  Shared by the interleaved-scan and sorted
+    patch assemblers so the two paths cannot diverge on patch shaping."""
+    written_idx = np.flatnonzero(written)
+    if written_idx.size == 0:
         return []
-    during = records["during"][r, i]
-    changed = records["changed"][r, i]
-    vis = records["vis"][r, i]
-    obj_len = int(records["obj_len"][r, i])
     action = "addMark" if int(op_row[K.K_MACTION]) == 0 else "removeMark"
     mark_type = schema.ALL_MARKS[int(op_row[K.K_MTYPE])]
     attr_values = attrs.decode(int(op_row[K.K_MATTR]))
 
     patches: List[Dict[str, Any]] = []
-    for j, p in enumerate(written):
+    for j, p in enumerate(written_idx):
         if not (during[p] and changed[p]):
             continue
         start = int(vis[p])
-        end = int(vis[written[j + 1]]) if j + 1 < written.size else obj_len
+        end = int(vis[written_idx[j + 1]]) if j + 1 < written_idx.size else obj_len
         # finishPartialPatch filters (peritext.ts:269-281).
         if end > start and start < obj_len:
             patch: Dict[str, Any] = {
@@ -166,6 +166,112 @@ def assemble_mark_patches(
             if action == "addMark" and mark_type in ("link", "comment"):
                 patch["attrs"] = attr_values
             patches.append(patch)
+    return patches
+
+
+def assemble_mark_patches(
+    records: Dict[str, np.ndarray],
+    r: int,
+    i: int,
+    op_row: np.ndarray,
+    attrs: AttrRegistry,
+) -> List[Dict[str, Any]]:
+    return _mark_patch_list(
+        records["written"][r, i],
+        records["during"][r, i],
+        records["changed"][r, i],
+        records["vis"][r, i],
+        int(records["obj_len"][r, i]),
+        op_row,
+        attrs,
+    )
+
+
+def assemble_patches_sorted(
+    records: Dict[str, np.ndarray],
+    r: int,
+    text_rows: np.ndarray,
+    text_pos: np.ndarray,
+    char_buf: np.ndarray,
+    mark_rows: np.ndarray,
+    mark_pos: np.ndarray,
+    table: Dict[str, Dict[str, Any]],
+    attrs: AttrRegistry,
+) -> List[Any]:
+    """(pos, patch) pairs from the sorted merge's compact records.
+
+    Text rows are FUSED (one record per run); a run expands to k insert
+    patches at consecutive stream positions and visible indices with one
+    shared inherited-marks decode — the per-char cost is dict construction,
+    not mark resolution.  Byte-equal to the interleaved assembler's stream
+    for the same delivery order (tests/test_sorted_merge differentials).
+    """
+    patches: List[Any] = []
+    op_ids = list(table)
+    mask_cache: Dict[bytes, Dict[str, Any]] = {}
+
+    def decode_mask(row: np.ndarray) -> Dict[str, Any]:
+        key = row.tobytes()
+        marks = mask_cache.get(key)
+        if marks is None:
+            present = frozenset(
+                op_id
+                for m, op_id in enumerate(op_ids)
+                if row[m // 32] >> (m % 32) & 1
+            )
+            marks = ops_to_marks(present, table)
+            mask_cache[key] = marks
+        return copy.deepcopy(marks)
+
+    kind = records["kind"][r]
+    tvalid = records["tvalid"][r]
+    index0 = records["index0"][r]
+    for l in range(text_rows.shape[0]):
+        kd = int(kind[l])
+        if kd == K.KIND_PAD or not tvalid[l]:
+            continue
+        pos0 = int(text_pos[l])
+        idx0 = int(index0[l])
+        if kd == K.KIND_DELETE:
+            patches.append(
+                (pos0, {"path": ["text"], "action": "delete", "index": idx0, "count": 1})
+            )
+            continue
+        if kd == K.KIND_INSERT_RUN:
+            n = int(text_rows[l, K.K_RUN_LEN])
+            start = int(text_rows[l, K.K_PAYLOAD])
+            values = [chr(int(c)) for c in char_buf[start : start + n]]
+        else:
+            n = 1
+            values = [chr(int(text_rows[l, K.K_PAYLOAD]))]
+        row_mask = records["ins_mask"][r, l]
+        for j in range(n):
+            patches.append(
+                (
+                    pos0 + j,
+                    {
+                        "path": ["text"],
+                        "action": "insert",
+                        "index": idx0 + j,
+                        "values": [values[j]],
+                        "marks": decode_mask(row_mask),
+                    },
+                )
+            )
+    for m in range(mark_rows.shape[0]):
+        if int(mark_rows[m, K.K_KIND]) != K.KIND_MARK:
+            continue
+        pos = int(mark_pos[m])
+        for patch in _mark_patch_list(
+            records["written"][r, m],
+            records["during"][r, m],
+            records["changed"][r, m],
+            records["vis"][r, m],
+            int(records["obj_len"][r, m]),
+            mark_rows[m],
+            attrs,
+        ):
+            patches.append((pos, patch))
     return patches
 
 
@@ -618,12 +724,35 @@ class TpuUniverse:
 
     # -- patch-emitting ingestion (the incremental codepath) ----------------
 
+    @staticmethod
+    def _patch_chunk(n: int) -> int:
+        """R-chunk size for patch-record launches (opt-in memory valve,
+        PERITEXT_PATCH_CHUNK), equalized so the jit caches hold at most two
+        program shapes (the even chunks and one smaller tail)."""
+        raw = os.environ.get("PERITEXT_PATCH_CHUNK", "0")
+        try:
+            chunk = int(raw)
+        except ValueError:
+            raise ValueError(f"PERITEXT_PATCH_CHUNK must be an integer, got {raw!r}")
+        if chunk < 0:
+            raise ValueError(f"PERITEXT_PATCH_CHUNK must be >= 0, got {chunk}")
+        chunk = chunk or n
+        return math.ceil(n / math.ceil(n / chunk))
+
     def apply_changes_with_patches(
         self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]
     ) -> Dict[str, List[Dict[str, Any]]]:
         """Causally-gated ingestion that also emits the reference Patch
-        stream per replica (micromerge.ts:25-30).  Uses the faithful
-        interleaved per-op path; the patch-free fast path is apply_changes."""
+        stream per replica (micromerge.ts:25-30).
+
+        Default path: the patch-emitting sorted merge (kernels.
+        merge_step_sorted_patched) — placement rounds for text, a scan over
+        mark rows only, analytic insert/delete records.  Deep batches fall
+        back to the faithful interleaved per-op scan, as does
+        PERITEXT_MERGE_PATH=scan / PERITEXT_PATCH_PATH=scan.  Both emit the
+        same byte-identical reference stream (micromerge dual-path
+        invariant, test/micromerge.ts:84-85).
+        """
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
         groups, group_of = prep["groups"], prep["group_of"]
@@ -650,6 +779,53 @@ class TpuUniverse:
                 name: [p for _, p in sorted(host_patches_for(r), key=lambda t: t[0])]
                 for r, name in enumerate(self.replica_ids)
             }
+
+        use_scan = (
+            os.environ.get("PERITEXT_MERGE_PATH") == "scan"
+            or os.environ.get("PERITEXT_PATCH_PATH") == "scan"
+        )
+        sorted_prep = None
+        if not use_scan:
+            text_rows_list: List[np.ndarray] = []
+            mark_rows_list: List[np.ndarray] = []
+            text_pos_list: List[np.ndarray] = []
+            mark_pos_list: List[np.ndarray] = []
+            for g in groups:
+                rows = g["rows"]
+                rp = np.asarray(g["row_pos"])
+                is_mark = rows[:, K.K_KIND] == K.KIND_MARK
+                text_rows_list.append(rows[~is_mark])
+                mark_rows_list.append(rows[is_mark])
+                text_pos_list.append(rp[~is_mark])
+                mark_pos_list.append(rp[is_mark])
+            sorted_prep = prepare_sorted_batch(
+                text_rows_list,
+                max_run=0,
+                fallback_max_rounds=int(
+                    os.environ.get("PERITEXT_SORTED_MAX_ROUNDS", "8")
+                ),
+                pos_list=text_pos_list,
+                restack_on_fallback=False,
+            )
+            if sorted_prep["fell_back"]:
+                use_scan = True
+                self.stats["scan_fallbacks"] += 1
+        if not use_scan:
+            return self._patched_sorted(
+                prep,
+                host_patches_for,
+                sorted_prep,
+                mark_rows_list,
+                mark_pos_list,
+                group_sizes,
+            )
+        return self._patched_scan(prep, host_patches_for, group_sizes, max_rows)
+
+    def _patched_scan(self, prep, host_patches_for, group_sizes, max_rows):
+        """The faithful interleaved per-op patch path (one scan step per
+        op; the reference's asymptotics, kept as the deep-batch fallback
+        and the PERITEXT_PATCH_PATH=scan differential leg)."""
+        groups, group_of = prep["groups"], prep["group_of"]
         pad = bucket_length(max_rows)
         g_ops = np.stack([pad_rows(g["rows"], pad) for g in groups])
         ops = g_ops[group_of]
@@ -665,17 +841,7 @@ class TpuUniverse:
         # mid-chunk failure rolls back to the pre-batch pytree and nothing
         # commits (same atomicity contract as the fast path).
         n = len(self.replica_ids)
-        raw = os.environ.get("PERITEXT_PATCH_CHUNK", "0")
-        try:
-            chunk = int(raw)
-        except ValueError:
-            raise ValueError(f"PERITEXT_PATCH_CHUNK must be an integer, got {raw!r}")
-        if chunk < 0:
-            raise ValueError(f"PERITEXT_PATCH_CHUNK must be >= 0, got {chunk}")
-        chunk = chunk or n
-        # Equalize chunk sizes where possible so the jit caches hold at most
-        # two program shapes (the even chunks and one smaller tail).
-        chunk = math.ceil(n / math.ceil(n / chunk))
+        chunk = self._patch_chunk(n)
         prev_states = self.states
         try:
             state_slices = []
@@ -707,6 +873,103 @@ class TpuUniverse:
             g = groups[group_of[r]]
             dev = assemble_patches(
                 rec, r % chunk, ops[r], tables[r], self.attrs, row_pos=g["row_pos"]
+            )
+            merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
+            out[name] = [p for _, p in merged]
+        return out
+
+    def _patched_sorted(
+        self,
+        prep,
+        host_patches_for,
+        sorted_prep,
+        mark_rows_list,
+        mark_pos_list,
+        sizes,
+    ):
+        """The patch-emitting sorted merge: placement rounds + mark-only
+        scan + analytic text records (kernels.merge_step_sorted_patched).
+        Record planes are [R, marks, 2C] — only mark rows, not every op —
+        so the memory valve matters less, but PERITEXT_PATCH_CHUNK still
+        applies."""
+        groups, group_of = prep["groups"], prep["group_of"]
+
+        mark_pad = bucket_length(
+            max(max((m.shape[0] for m in mark_rows_list), default=1), 1)
+        )
+        g_mark = np.stack([pad_rows(m, mark_pad) for m in mark_rows_list])
+        g_mark_pos = np.stack(
+            [
+                np.pad(
+                    p.astype(np.int64),
+                    (0, mark_pad - p.shape[0]),
+                    constant_values=TIME_PAD,
+                )
+                for p in mark_pos_list
+            ]
+        ).astype(np.int32)
+
+        text_ops = sorted_prep["text"][group_of]
+        rounds = sorted_prep["rounds"][group_of]
+        bufs = sorted_prep["bufs"][group_of]
+        text_pos = sorted_prep["text_pos"][group_of]
+        mark_ops = g_mark[group_of]
+        mark_pos = g_mark_pos[group_of]
+        ranks = jax.numpy.asarray(self._ranks())
+        multi = jax.numpy.asarray(allow_multiple_array())
+        pad_per_group = (sorted_prep["text"][:, :, K.K_KIND] == K.KIND_PAD).sum(
+            axis=1
+        ) + (g_mark[:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1)
+        self.stats["rows_padded"] += int((pad_per_group * sizes).sum())
+
+        n = len(self.replica_ids)
+        chunk = self._patch_chunk(n)
+        prev_states = self.states
+        try:
+            state_slices = []
+            record_chunks: List[Dict[str, np.ndarray]] = []
+            for i in range(0, n, chunk):
+                sl = slice(i, min(i + chunk, n))
+                self.stats["launches"] += 1
+                st, records = K.merge_step_sorted_patched_batch(
+                    jax.tree.map(lambda x: x[sl], self.states),
+                    jax.numpy.asarray(text_ops[sl]),
+                    jax.numpy.asarray(rounds[sl]),
+                    sorted_prep["num_rounds"],
+                    jax.numpy.asarray(mark_ops[sl]),
+                    ranks,
+                    jax.numpy.asarray(bufs[sl]),
+                    multi,
+                    jax.numpy.asarray(text_pos[sl]),
+                    jax.numpy.asarray(mark_pos[sl]),
+                    sorted_prep["maxk"],
+                )
+                state_slices.append(st)
+                record_chunks.append({k: np.asarray(v) for k, v in records.items()})
+            self.states = (
+                state_slices[0]
+                if len(state_slices) == 1
+                else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
+            )
+        except Exception:
+            self.states = prev_states
+            raise
+        self._commit(prep)
+        tables = self._batch_mark_op_table()
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for r, name in enumerate(self.replica_ids):
+            rec = record_chunks[r // chunk]
+            gi = int(group_of[r])
+            dev = assemble_patches_sorted(
+                rec,
+                r % chunk,
+                sorted_prep["text"][gi],
+                sorted_prep["text_pos"][gi],
+                sorted_prep["bufs"][gi],
+                g_mark[gi],
+                g_mark_pos[gi],
+                tables[r],
+                self.attrs,
             )
             merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
             out[name] = [p for _, p in merged]

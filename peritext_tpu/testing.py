@@ -5,11 +5,41 @@ and the concurrent-write harness shape (test/micromerge.ts:46-86).
 """
 from __future__ import annotations
 
+import os
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from peritext_tpu.oracle import Doc, accumulate_patches
 
 DEFAULT_TEXT = "The Peritext editor"
+
+# Every env knob that can force the patch path off the sorted merge.  An
+# honest sorted-vs-scan A/B must clear ALL of these for its sorted leg;
+# keep this list in sync with universe.apply_changes_with_patches.
+SCAN_FORCING_KNOBS = ("PERITEXT_PATCH_PATH", "PERITEXT_MERGE_PATH")
+
+
+@contextmanager
+def patch_path_env(mode: Optional[str] = None):
+    """Pin the patch-path selection for a measurement or differential leg.
+
+    ``mode=None`` clears every scan-forcing knob (the sorted path becomes
+    selectable regardless of ambient CI env); ``mode="scan"`` forces the
+    interleaved scan.  The caller's environment is restored on exit.
+    """
+    saved = {k: os.environ.get(k) for k in SCAN_FORCING_KNOBS}
+    for k in SCAN_FORCING_KNOBS:
+        os.environ.pop(k, None)
+    if mode:
+        os.environ["PERITEXT_PATCH_PATH"] = mode
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def generate_docs(

@@ -65,6 +65,9 @@ run_steps() {
   # 4. Pallas vs sorted A/B at the bench shape (VERDICT item 4).
   step bench_pallas.json 2100 env BENCH_PALLAS=1 BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1
+  # 4b. Patch-emitting ingest path A/B (VERDICT item 5).
+  step bench_patched.json 2100 env BENCH_PATCHES=ab BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
+  probe || return 1
   # 5. Splice strategy A/B on hardware.
   step bench_scatter.json 2100 env PERITEXT_SPLICE=scatter BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   probe || return 1

@@ -167,3 +167,100 @@ def test_patch_path_chunked_matches_unchunked(monkeypatch):
     chk_patches, chk_spans = run(2)  # 5 replicas -> chunks of 2 + tail of 1
     assert chk_patches == ref_patches
     assert chk_spans == ref_spans
+
+
+def _stream_with_interleaved_marks():
+    """A single writer interleaving marks INTO an insert chain within one
+    change: each later insert references the previous op's element, so
+    naive run fusion would bridge across the mark — exactly the case the
+    delivery-adjacency gate (encode.fuse_insert_runs pos) exists for."""
+    docs, _, initial_change = generate_docs("base")
+    doc = docs[0]
+    change, _ = doc.change(
+        [
+            {"path": ["text"], "action": "insert", "index": 4, "values": list("ab")},
+            # Inclusive mark ending at the chain's tip: the next chars'
+            # insert patches must inherit it (peritext.ts:328-330).
+            {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 6,
+             "markType": "strong"},
+            {"path": ["text"], "action": "insert", "index": 6, "values": list("cd")},
+            {"path": ["text"], "action": "removeMark", "startIndex": 2, "endIndex": 8,
+             "markType": "strong"},
+            {"path": ["text"], "action": "insert", "index": 8, "values": list("ef")},
+        ]
+    )
+    return [initial_change, change]
+
+
+def _patch_paths(stream, replicas=("observer",), batches=None):
+    """Run the same delivery through the sorted and forced-scan patch paths
+    on fresh universes; returns (sorted_out, scan_out, sorted_spans,
+    scan_spans).  The sorted leg clears ambient scan-forcing knobs
+    (testing.patch_path_env) so the differential stays real under the
+    scan-forced CI mode."""
+    from peritext_tpu.testing import patch_path_env
+
+    batches = batches or {replicas[0]: stream}
+    outs = []
+    for mode in (None, "scan"):
+        with patch_path_env(mode):
+            uni = TpuUniverse(list(replicas))
+            out = uni.apply_changes_with_patches(batches)
+            outs.append((out, [uni.spans(r) for r in replicas]))
+    (sorted_out, sorted_spans), (scan_out, scan_spans) = outs
+    return sorted_out, scan_out, sorted_spans, scan_spans
+
+
+def test_sorted_patch_path_gates_fusion_on_delivery_adjacency():
+    stream = _stream_with_interleaved_marks()
+    oracle = Doc("observer")
+    oracle_patches = []
+    for change in stream:
+        oracle_patches.extend(oracle.apply_change(change))
+    sorted_out, scan_out, sorted_spans, scan_spans = _patch_paths(stream)
+    assert sorted_out["observer"] == scan_out["observer"] == oracle_patches
+    assert sorted_spans == scan_spans == [oracle.get_text_with_formatting(["text"])]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_sorted_patch_path_matches_scan_random(seed):
+    """Randomized multi-writer streams (multi-op changes, marks inside
+    insert chains, deletes of fresh chars) through both patch paths."""
+    rng = random.Random(seed + 777)
+    docs, _, initial_change = generate_docs("Peritext!", 3)
+    stream = [initial_change]
+    comment_history = []
+    for _ in range(12):
+        doc = docs[rng.randrange(3)]
+        ops = []
+        for _ in range(rng.randrange(1, 4)):
+            kind = rng.choice(["insert", "insert", "remove", "addMark", "removeMark"])
+            if kind == "insert":
+                op = _random_insert(rng, doc, 4)
+            elif kind == "remove":
+                op = _random_delete(rng, doc)
+            elif kind == "addMark":
+                op = _random_add_mark(rng, doc, comment_history)
+            else:
+                op = _random_remove_mark(rng, doc, comment_history, False)
+            if op is not None:
+                # Apply incrementally so later ops' indices are in range.
+                change, _ = doc.change([op])
+                stream.append(change)
+                for other in docs:
+                    if other is not doc:
+                        other.apply_change(change)
+
+    oracle = Doc("observer")
+    oracle_patches = []
+    for change in stream:
+        oracle_patches.extend(oracle.apply_change(change))
+    # Two replicas with different-size batches exercise group expansion.
+    batches = {"observer": stream, "late": stream[: len(stream) // 2]}
+    sorted_out, scan_out, sorted_spans, scan_spans = _patch_paths(
+        stream, replicas=("observer", "late"), batches=batches
+    )
+    assert sorted_out["observer"] == scan_out["observer"] == oracle_patches
+    assert sorted_out["late"] == scan_out["late"]
+    assert sorted_spans == scan_spans
+    assert sorted_spans[0] == oracle.get_text_with_formatting(["text"])

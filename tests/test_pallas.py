@@ -52,7 +52,7 @@ def test_pallas_fused_runs_match_xla(seed):
     batch = build_device_batch(workload, num_replicas=8, capacity=256, max_mark_ops=64)
     fused, bufs = [], []
     for r in range(8):
-        fr, fb = fuse_insert_runs(batch["text_ops"][r])
+        fr, fb, _ = fuse_insert_runs(batch["text_ops"][r])
         fused.append(fr)
         bufs.append(fb)
     text_pad = max(max(f.shape[0] for f in fused), 1)

@@ -28,7 +28,7 @@ def test_typing_run_fuses_to_one_row():
         [{"path": ["text"], "action": "insert", "index": 0, "values": list("hello world")}]
     )
     rows, _ = encode_stream([change])
-    fused, buf = fuse_insert_runs(rows)
+    fused, buf, _ = fuse_insert_runs(rows)
     assert rows.shape[0] == 11
     assert fused.shape[0] == 1
     assert fused[0][K.K_KIND] == K.KIND_INSERT_RUN
@@ -43,7 +43,7 @@ def test_long_run_splits_at_cap():
         [{"path": ["text"], "action": "insert", "index": 0, "values": ["x"] * 150}]
     )
     rows, _ = encode_stream([change])
-    fused, _ = fuse_insert_runs(rows)
+    fused, _, _ = fuse_insert_runs(rows)
     kinds = fused[:, K.K_KIND].tolist()
     lens = fused[:, K.K_RUN_LEN].tolist()
     assert kinds.count(K.KIND_INSERT_RUN) == 3
@@ -92,7 +92,7 @@ def test_fused_matches_per_op(seed):
 
     rows, actors = encode_stream(stream)
     text_rows, mark_rows = split_rows(rows)
-    fused_rows, buf = fuse_insert_runs(text_rows)
+    fused_rows, buf, _ = fuse_insert_runs(text_rows)
     assert fused_rows.shape[0] < text_rows.shape[0]  # fusion happened
 
     ranks = np.zeros(8, np.int32)

@@ -138,7 +138,7 @@ def test_sorted_merge_unbounded_run_is_single_round():
     rows, _, _ = encode_changes(
         [change], actors, attrs, text_obj=change["ops"][0].get("obj")
     )
-    fused, _ = fuse_insert_runs(rows, max_run=0)
+    fused, _, _ = fuse_insert_runs(rows, max_run=0)
     assert fused.shape[0] == 1
     ro, nr = compute_rounds(fused)
     assert nr == 1
