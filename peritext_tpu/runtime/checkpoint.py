@@ -33,6 +33,13 @@ import dataclasses
 
 _STATE_FIELDS = [f.name for f in dataclasses.fields(DocState)]
 
+# Sidecar format version.  2 = the host-object-store plane ('stores' +
+# 'text_objs' + 'format'); 1 (implicit, no 'format' key) = the same layout
+# before the version field existed.  Anything older (the pre-round-2
+# 'roots' layout) is rejected with an explicit error instead of a bare
+# KeyError deep in load.
+CHECKPOINT_FORMAT = 2
+
 
 def save_universe(uni: TpuUniverse, path: str) -> None:
     arrays = {f: np.asarray(getattr(uni.states, f)) for f in _STATE_FIELDS}

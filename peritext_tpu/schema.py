@@ -25,11 +25,22 @@ from typing import Tuple
 
 @dataclass(frozen=True)
 class MarkSpec:
-    """Configuration for one mark type (reference schema.ts:45-96)."""
+    """Configuration for one mark type (reference schema.ts:45-96).
+
+    ``excludes`` is the editor-facing exclusion group (reference
+    schema.ts:77, the ProseMirror markSpec field): None means the editor
+    default (a mark excludes its own type), "" excludes nothing (how
+    comment permits same-type overlap at the editor layer), and a
+    space-separated name list names an explicit group.  CRDT merge
+    behavior reads ``allow_multiple`` — ``excludes`` configures the
+    consuming editor's schema, exactly as in the reference where the CRDT
+    reads allowMultiple (peritext.ts:304) and ProseMirror reads excludes.
+    """
 
     inclusive: bool
     allow_multiple: bool
     attr_keys: Tuple[str, ...] = ()
+    excludes: "str | None" = None
 
 
 # The four mark types of the reference schema, in declaration order.
@@ -37,7 +48,9 @@ class MarkSpec:
 MARK_SPEC: "dict[str, MarkSpec]" = {
     "strong": MarkSpec(inclusive=True, allow_multiple=False),
     "em": MarkSpec(inclusive=True, allow_multiple=False),
-    "comment": MarkSpec(inclusive=False, allow_multiple=True, attr_keys=("id",)),
+    "comment": MarkSpec(
+        inclusive=False, allow_multiple=True, attr_keys=("id",), excludes=""
+    ),
     "link": MarkSpec(inclusive=False, allow_multiple=False, attr_keys=("url",)),
 }
 
@@ -73,6 +86,7 @@ def register_mark_type(
     inclusive: bool,
     allow_multiple: bool = False,
     attr_keys: Tuple[str, ...] = (),
+    excludes: "str | None" = None,
 ) -> None:
     """Extend the mark schema at runtime (the reference's demoMarkSpec
     pattern, schema.ts:99-121: demos add highlightChange/unhighlightChange
@@ -82,7 +96,12 @@ def register_mark_type(
     existing type raises.  Register before creating the documents that use
     the type — mark-type ids are append-only, so existing docs stay valid.
     """
-    spec = MarkSpec(inclusive=inclusive, allow_multiple=allow_multiple, attr_keys=tuple(attr_keys))
+    spec = MarkSpec(
+        inclusive=inclusive,
+        allow_multiple=allow_multiple,
+        attr_keys=tuple(attr_keys),
+        excludes=excludes,
+    )
     existing = MARK_SPEC.get(name)
     if existing is not None:
         if existing != spec:
