@@ -45,7 +45,12 @@ def show(net, label):
 
 def main():
     # Seed matches the reference demo: bold+italic+comment+link present.
-    net = EditorNetwork(["alice", "bob"], initial_text="The Peritext editor")
+    # The queue interval is the latency knob (changeQueue.ts:17-19) for the
+    # final auto-flush act; until then queues stay in manual mode.
+    latency = float(os.environ.get("LIVE_LATENCY", "0.05"))
+    net = EditorNetwork(
+        ["alice", "bob"], initial_text="The Peritext editor", interval=latency
+    )
     net["alice"].toggle_mark(0, 3, "strong")
     net["alice"].toggle_mark(4, 12, "em")
     net["alice"].add_comment(4, 12, "seeded comment")
@@ -68,6 +73,23 @@ def main():
     for change in net["alice"].change_log:
         for op in change["ops"]:
             print("   ", describe_op(op))
+
+    # Latency-simulation act: switch the queues to interval-driven flushing
+    # (the reference's simulated network delay, changeQueue.ts:17-19) and
+    # watch edits propagate on the timer instead of a Sync click.
+    import time
+
+    net.start_all()
+    try:
+        net["alice"].insert(len(net["alice"].text()), " (live)")
+        net["bob"].toggle_mark(0, 1, "em")
+        deadline = time.monotonic() + max(5.0, latency * 100)
+        while not net.converged() and time.monotonic() < deadline:
+            time.sleep(latency / 2)
+    finally:
+        net.stop_all()
+    show(net, f"after {latency * 1e3:.0f}ms-interval auto-flush (no Sync click)")
+    assert net.converged()
 
 
 if __name__ == "__main__":

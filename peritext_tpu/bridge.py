@@ -17,6 +17,7 @@ Editor "steps" mirror ProseMirror's step vocabulary:
 from __future__ import annotations
 
 import random
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from peritext_tpu.oracle import Doc
@@ -108,6 +109,7 @@ class Editor:
         editable: bool = True,
         on_patch: Optional[Callable[[Patch], None]] = None,
         on_remote_patch: Optional[Callable[[Patch], None]] = None,
+        lock: Optional["threading.RLock"] = None,
     ) -> None:
         self.doc = doc
         self.publisher = publisher
@@ -116,8 +118,19 @@ class Editor:
         self.on_remote_patch = on_remote_patch
         self.comments: Dict[str, Comment] = {}
         self.change_log: List[Dict[str, Any]] = []
+        # Doc mutation guard for interval-driven mode: the queue timer
+        # delivers remote changes on its own thread while the caller may be
+        # mid-change() (or mid-read) on the same docs.  Defaults to the
+        # PUBLISHER's lock so every editor on one publisher shares it by
+        # construction — delivery happens inside a flush, so per-editor
+        # locks would deadlock (A's flush holding A wanting B while B's
+        # flush holds B wanting A).  RLock: a local change can publish
+        # inline through its own flush.
+        self.lock = lock if lock is not None else publisher.lock
         self.queue = ChangeQueue(
-            handle_flush=self._publish_changes, interval=interval
+            handle_flush=self._publish_changes,
+            interval=interval,
+            flush_lock=self.lock,
         )
         publisher.subscribe(doc.actor_id, self._receive_changes)
 
@@ -125,7 +138,8 @@ class Editor:
 
     def _publish_changes(self, changes: List[Dict[str, Any]]) -> None:
         if changes:
-            self.publisher.publish(self.doc.actor_id, changes)
+            with self.lock:
+                self.publisher.publish(self.doc.actor_id, changes)
 
     def apply_steps(self, steps: Sequence[Step]) -> List[Patch]:
         """Translate editor steps into one transactional change."""
@@ -136,9 +150,10 @@ class Editor:
             input_ops.extend(self._step_to_ops(step))
         if not input_ops:
             return []
-        change, patches = self.doc.change(input_ops)
-        self.change_log.append(change)
-        self.queue.enqueue(change)
+        with self.lock:
+            change, patches = self.doc.change(input_ops)
+            self.change_log.append(change)
+            self.queue.enqueue(change)
         if self.on_patch:
             for patch in patches:
                 self.on_patch(patch)
@@ -201,7 +216,8 @@ class Editor:
     # -- inbound -----------------------------------------------------------
 
     def _receive_changes(self, changes: Sequence[Dict[str, Any]]) -> None:
-        patches = apply_changes(self.doc, list(changes))
+        with self.lock:
+            patches = apply_changes(self.doc, list(changes))
         for patch in patches:
             if self.on_patch:
                 self.on_patch(patch)
@@ -211,10 +227,12 @@ class Editor:
     # -- views ---------------------------------------------------------------
 
     def spans(self) -> List[Dict[str, Any]]:
-        return self.doc.get_text_with_formatting(["text"])
+        with self.lock:
+            return self.doc.get_text_with_formatting(["text"])
 
     def text(self) -> str:
-        return "".join(self.doc.root.get("text", []))
+        with self.lock:
+            return "".join(self.doc.root.get("text", []))
 
     def sync(self) -> None:
         """Manual flush (the demo Sync button, index.ts:124-128)."""
@@ -412,6 +430,8 @@ class EditorNetwork:
             else None
         )
         self.genesis = initialize_docs(docs, initial_ops)
+        # Editors default to the shared publisher lock, so the whole fleet
+        # serializes on one RLock by construction.
         self.editors: Dict[str, Editor] = {
             doc.actor_id: Editor(doc, self.publisher, **editor_kwargs) for doc in docs
         }
@@ -422,6 +442,19 @@ class EditorNetwork:
     def sync_all(self) -> None:
         for editor in self.editors.values():
             editor.sync()
+
+    def start_all(self) -> None:
+        """Switch every editor to interval-driven flushing — the reference's
+        latency simulator (changeQueue.ts:17-19: the flush interval is the
+        simulated network delay; index.ts runs with it before the demo drops
+        to manual sync)."""
+        for editor in self.editors.values():
+            editor.queue.start()
+
+    def stop_all(self) -> None:
+        """Back to manual-sync mode (queue.drop, index.ts:119-121)."""
+        for editor in self.editors.values():
+            editor.queue.drop()
 
     def converged(self) -> bool:
         spans = [e.spans() for e in self.editors.values()]

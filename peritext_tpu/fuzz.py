@@ -18,8 +18,10 @@ Differences from the reference fuzzer, on purpose:
 """
 from __future__ import annotations
 
+import itertools
 import json
 import math
+import os
 import random
 from typing import Any, Callable, Dict, List, Optional
 
@@ -38,6 +40,9 @@ class FuzzError(AssertionError):
         self.state = state
 
     def save(self, path: str) -> None:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
             json.dump(self.state, f)
 
@@ -202,8 +207,13 @@ def fuzz(
     doc_factory: Callable[[str], Any] = Doc,
     check_patches: bool = True,
     nested: bool = False,
+    report_every: int = 0,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
+
+    ``iterations=0`` runs unbounded (the reference's ``while(true)``,
+    fuzz.ts:167) — stop it externally; progress lines (``report_every``) are
+    the soak record.
 
     With ``nested``, a share of iterations drive the host structural plane
     (nested makeMap/makeList/set/del, second-list edits and marks) and every
@@ -235,7 +245,8 @@ def fuzz(
         }
         raise FuzzError(message, state)
 
-    for _ in range(iterations):
+    done = 0
+    for done in itertools.count(1) if iterations == 0 else range(1, iterations + 1):
         target = rng.randrange(len(docs))
         doc = docs[target]
         kinds = ["insert", "remove", "addMark", "removeMark"]
@@ -304,26 +315,79 @@ def fuzz(
                         f"nested span divergence at {path}",
                         {"left": ls, "right": rs},
                     )
+        # Progress AFTER the iteration's checks: a soak line never claims
+        # an iteration that hasn't fully converged.
+        if report_every and done % report_every == 0:
+            length = sum(
+                len(s["text"]) for s in docs[0].get_text_with_formatting(["text"])
+            )
+            print(f"fuzz: {done} iterations ok, doc length {length}", flush=True)
 
     return {
         "docs": docs,
         "log": log,
         "patches": all_patches,
+        "iterations": done,
         "final_spans": docs[0].get_text_with_formatting(["text"]),
     }
 
 
-if __name__ == "__main__":
-    import sys
+def _main() -> None:
+    import argparse
 
-    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
-    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    parser = argparse.ArgumentParser(
+        description="Convergence fuzzer (reference: test/fuzz.ts). "
+        "iters=0 runs unbounded, like the reference's while(true)."
+    )
+    parser.add_argument("iters", nargs="?", type=int, default=1000)
+    parser.add_argument("seed", nargs="?", type=int, default=0)
+    parser.add_argument(
+        "--engine", choices=["oracle", "tpu"], default="oracle",
+        help="doc factory under test (tpu = TpuDoc differential vs oracle semantics)",
+    )
+    parser.add_argument("--nested", action="store_true", help="also fuzz nested objects")
+    parser.add_argument(
+        "--report-every", type=int, default=1000,
+        help="progress line every N iterations (0 = silent)",
+    )
+    parser.add_argument(
+        "--trace-dir", default="traces", help="where failure traces are written"
+    )
+    parser.add_argument(
+        "--platform", default="cpu",
+        help="JAX platform for --engine tpu (default cpu; 'ambient' keeps "
+        "the process default, i.e. the relayed TPU when it serves)",
+    )
+    args = parser.parse_args()
+
+    if args.engine == "tpu":
+        if args.platform != "ambient":
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        from peritext_tpu.ops.doc import TpuDoc
+
+        factory: Callable[[str], Any] = TpuDoc
+    else:
+        factory = Doc
     try:
-        result = fuzz(iterations=iters, seed=seed)
+        result = fuzz(
+            iterations=args.iters,
+            seed=args.seed,
+            doc_factory=factory,
+            nested=args.nested,
+            report_every=args.report_every,
+        )
     except FuzzError as err:
-        path = f"traces/fail-seed{seed}.json"
+        path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")
         err.save(path)
         print(f"FAILED: {err}; trace written to {path}")
         raise
-    print(f"ok: {iters} iterations, final doc length "
-          f"{sum(len(s['text']) for s in result['final_spans'])}")
+    print(
+        f"ok: {result['iterations']} iterations, final doc length "
+        f"{sum(len(s['text']) for s in result['final_spans'])}"
+    )
+
+
+if __name__ == "__main__":
+    _main()

@@ -241,7 +241,10 @@ def place_text_sp(mesh: Mesh, halo: int, maxk: int):
         oi = shard * c_local + jnp.arange(c_local, dtype=jnp.int32)
         # The initial orig-idx plane is seq-varying only; the loop mixes it
         # with replica-varying data, so align its varying axes up front.
-        oi = lax.pvary(oi, ("replica",))
+        if hasattr(lax, "pcast"):
+            oi = lax.pcast(oi, ("replica",), to="varying")
+        else:  # JAX < pcast: pvary is the only spelling
+            oi = lax.pvary(oi, ("replica",))
         carry = (ec, ea, dl, ch, oi, ln)
         carry = lax.fori_loop(
             0,

@@ -50,6 +50,7 @@ def save_universe(uni: TpuUniverse, path: str) -> None:
         np.savez_compressed(f, **arrays)
     os.replace(tmp_npz, path + ".npz")
     sidecar = {
+        "format": CHECKPOINT_FORMAT,
         "replica_ids": uni.replica_ids,
         "clocks": uni.clocks,
         "lengths": uni.lengths,
@@ -70,6 +71,7 @@ def save_universe(uni: TpuUniverse, path: str) -> None:
                 "inclusive": spec.inclusive,
                 "allow_multiple": spec.allow_multiple,
                 "attr_keys": list(spec.attr_keys),
+                "excludes": spec.excludes,
             }
             for name, spec in schema.MARK_SPEC.items()
         ],
@@ -101,6 +103,8 @@ def _restore_mark_schema(sidecar: Dict[str, Any]) -> None:
                 or entry["inclusive"] != spec.inclusive
                 or entry["allow_multiple"] != spec.allow_multiple
                 or tuple(entry["attr_keys"]) != spec.attr_keys
+                # Older snapshots (no 'excludes' key) validate flags only.
+                or ("excludes" in entry and entry["excludes"] != spec.excludes)
             ):
                 raise ValueError(
                     f"snapshot mark schema mismatch at id {i}: snapshot has "
@@ -113,12 +117,21 @@ def _restore_mark_schema(sidecar: Dict[str, Any]) -> None:
                 inclusive=entry["inclusive"],
                 allow_multiple=entry["allow_multiple"],
                 attr_keys=tuple(entry["attr_keys"]),
+                excludes=entry.get("excludes"),
             )
 
 
 def load_universe(path: str) -> TpuUniverse:
     with open(path + ".json") as f:
         sidecar = json.load(f)
+    fmt = sidecar.get("format", 1)
+    if fmt > CHECKPOINT_FORMAT or "stores" not in sidecar:
+        raise ValueError(
+            f"snapshot {path!r} has format {fmt} "
+            f"(this build reads <= {CHECKPOINT_FORMAT}"
+            + ("" if "stores" in sidecar else "; pre-round-2 'roots' layout")
+            + "); re-save it with a matching build or replay its change log"
+        )
     _restore_mark_schema(sidecar)
     uni = TpuUniverse(
         sidecar["replica_ids"],

@@ -5,6 +5,7 @@ update out to every subscriber except the sender.
 """
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, Generic, TypeVar
 
 T = TypeVar("T")
@@ -13,6 +14,12 @@ T = TypeVar("T")
 class Publisher(Generic[T]):
     def __init__(self) -> None:
         self._subscribers: Dict[str, Callable[[T], None]] = {}
+        # One reentrant lock per publisher: every editor on this publisher
+        # serializes doc mutation/delivery on it, so interval-driven (timer
+        # thread) flushes can never interleave with local edits or with each
+        # other — and a single shared lock cannot deadlock the way
+        # per-editor locks would (delivery happens inside a flush).
+        self.lock = threading.RLock()
 
     def subscribe(self, key: str, callback: Callable[[T], None]) -> None:
         if key in self._subscribers:

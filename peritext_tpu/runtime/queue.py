@@ -19,21 +19,28 @@ class ChangeQueue:
         self,
         handle_flush: Callable[[List[Any]], None],
         interval: float = 0.01,
+        flush_lock: Optional["threading.RLock"] = None,
     ) -> None:
         self._changes: List[Any] = []
         self._handle_flush = handle_flush
         self._interval = interval
         self._timer: Optional[threading.Timer] = None
         self._lock = threading.Lock()
+        # Held across pop+handle so two concurrent flushes (timer thread vs
+        # a manual sync) cannot publish one actor's changes out of seq
+        # order.  Callers pass a shared reentrant lock (the Editor passes
+        # its publisher's); default is a private one.
+        self._flush_lock = flush_lock if flush_lock is not None else threading.RLock()
 
     def enqueue(self, *changes: Any) -> None:
         with self._lock:
             self._changes.extend(changes)
 
     def flush(self) -> None:
-        with self._lock:
-            changes, self._changes = self._changes, []
-        self._handle_flush(changes)
+        with self._flush_lock:
+            with self._lock:
+                changes, self._changes = self._changes, []
+            self._handle_flush(changes)
 
     def _tick(self) -> None:
         self.flush()

@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Phase breakdown of the patch-emitting sorted ingest (VERDICT r3 item 4).
+
+Times each phase of TpuUniverse.apply_changes_with_patches separately at the
+patched-bench shape: host prepare/encode, device launch, record readback,
+commit + mark-table build, and the per-replica host patch assembly — so the
+4x no-patch vs patched gap can be attributed before optimizing.
+
+    python scripts/patched_breakdown.py [R] [ops_per_merge]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import random
+
+import numpy as np
+
+# Pin CPU before first backend use (sitecustomize pins axon,cpu; a wedged
+# relay would hang this script's first device op otherwise).  Set
+# PATCHED_BREAKDOWN_PLATFORM=ambient to profile on real hardware.
+if os.environ.get("PATCHED_BREAKDOWN_PLATFORM", "cpu") == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    ops_per_merge = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    doc_len = 1000
+
+    import jax
+
+    from peritext_tpu.bench.workloads import (
+        _patched_writers,
+        _random_add_mark,
+        _random_delete,
+        _random_insert,
+    )
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.ops import universe as U
+    from peritext_tpu.ops import kernels as K
+
+    rng = random.Random(0)
+    writers, _, genesis = _patched_writers(doc_len, rng)
+    stream, n_ops = [], 0
+    while n_ops < ops_per_merge:
+        writer = writers[rng.randrange(len(writers))]
+        kind = rng.choice(["insert", "insert", "remove", "addMark"])
+        op = (
+            _random_insert(rng, writer, 6)
+            if kind == "insert"
+            else _random_delete(rng, writer)
+            if kind == "remove"
+            else _random_add_mark(rng, writer, [])
+        )
+        if op is None:
+            continue
+        change, _ = writer.change([op])
+        n_ops += len(change["ops"])
+        stream.append(change)
+        for other in writers:
+            if other is not writer:
+                other.apply_change(change)
+
+    names = [f"r{i}" for i in range(R)]
+    capacity = 1
+    while capacity < doc_len + n_ops + 64:
+        capacity *= 2
+
+    # Wrap the phase boundaries with timers.
+    t = {}
+
+    def wrap(obj, name, key):
+        orig = getattr(obj, name)
+
+        def timed(*a, **kw):
+            t0 = time.perf_counter()
+            out = orig(*a, **kw)
+            t[key] = t.get(key, 0.0) + time.perf_counter() - t0
+            return out
+
+        setattr(obj, name, timed)
+        return orig
+
+    def build():
+        uni = TpuUniverse(names, capacity=capacity)
+        uni.apply_changes_with_patches({n: [genesis] for n in names})
+        return uni
+
+    build().apply_changes_with_patches({n: list(stream) for n in names})  # warm
+
+    orig_launch = K.merge_step_sorted_patched_batch
+    orig_asarray = np.asarray
+
+    def timed_launch(*a, **kw):
+        t0 = time.perf_counter()
+        st, records = orig_launch(*a, **kw)
+        jax.block_until_ready(records)
+        t["device_launch"] = t.get("device_launch", 0.0) + time.perf_counter() - t0
+        return st, records
+
+    K.merge_step_sorted_patched_batch = timed_launch
+    wrap(TpuUniverse, "_prepare", "host_prepare")
+    wrap(TpuUniverse, "_commit", "commit")
+    wrap(TpuUniverse, "_batch_mark_op_table", "mark_table")
+    assemble = wrap(U, "assemble_patches_sorted", "assemble_host")
+
+    # readback = the np.asarray over record dicts inside _patched_sorted;
+    # measured as total minus the other phases (it is the only remaining
+    # bulk step), plus directly below.
+    uni = build()
+    t.clear()
+    start = time.perf_counter()
+    out = uni.apply_changes_with_patches({n: list(stream) for n in names})
+    total = time.perf_counter() - start
+    K.merge_step_sorted_patched_batch = orig_launch
+
+    n_patches = sum(len(v) for v in out.values())
+    accounted = sum(t.values())
+    print(f"R={R} ops/merge={n_ops} total_ops={R * n_ops} patches={n_patches}")
+    print(f"total          {total * 1e3:9.1f} ms   ops/s={R * n_ops / total:,.0f}")
+    for key in sorted(t, key=t.get, reverse=True):
+        print(f"{key:14s} {t[key] * 1e3:9.1f} ms   {100 * t[key] / total:5.1f}%")
+    print(
+        f"{'other':14s} {(total - accounted) * 1e3:9.1f} ms   "
+        f"{100 * (total - accounted) / total:5.1f}%  (readback np.asarray + glue)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -219,3 +219,33 @@ def test_editor_doc_round_trips_live_session():
     doc = editor_doc_from_spans(net["b"].spans())
     assert editor_doc_text(doc) == "one\ntwo"
     assert doc["content"][0]["content"][0]["marks"] == {"strong": {"active": True}}
+
+
+def test_interval_driven_latency_simulation():
+    """The queue's flush interval is the latency simulator (reference
+    changeQueue.ts:17-19): edits stay local until the timer fires, then the
+    fleet converges with no manual sync."""
+    import time
+
+    from peritext_tpu.bridge import EditorNetwork
+
+    net = EditorNetwork(["alice", "bob"], initial_text="shared", interval=0.05)
+    try:
+        net.start_all()
+        net["alice"].insert(6, " doc")
+        net["bob"].toggle_mark(0, 6, "strong")
+        # Inside the latency window the edit is queued, not delivered.
+        # Snapshot bob BEFORE checking the queue: if the queue is still
+        # non-empty afterwards, the snapshot predates the flush, so the
+        # check cannot race the timer.
+        bob_text = net["bob"].text()
+        if len(net["alice"].queue):
+            assert bob_text == "shared"
+        deadline = time.monotonic() + 5.0
+        while not net.converged() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert net.converged()
+        assert net["bob"].text() == "shared doc"
+        assert net["alice"].spans() == net["bob"].spans()
+    finally:
+        net.stop_all()

@@ -125,6 +125,85 @@ def test_snapshot_persists_mark_schema(tmp_path):
         load_universe(path)
 
 
+def test_snapshot_format_versioned(tmp_path):
+    """The sidecar carries a format version; unknown/older layouts are
+    rejected with an explicit error, not a KeyError deep in load
+    (round-3 ADVICE)."""
+    import json
+
+    import pytest
+
+    from peritext_tpu.runtime.checkpoint import CHECKPOINT_FORMAT
+
+    _, _, uni = build_session(tmp_path)
+    path = os.path.join(tmp_path, "snap")
+    save_universe(uni, path)
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    assert sidecar["format"] == CHECKPOINT_FORMAT
+
+    # Future format: rejected loudly.
+    sidecar["format"] = CHECKPOINT_FORMAT + 1
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+    with pytest.raises(ValueError, match="format"):
+        load_universe(path)
+
+    # Pre-round-2 'roots' layout (no 'stores'): rejected loudly.
+    del sidecar["format"]
+    roots = sidecar.pop("stores")
+    sidecar["roots"] = roots
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+    with pytest.raises(ValueError, match="roots"):
+        load_universe(path)
+
+
+def test_snapshot_round_trips_excludes(tmp_path):
+    """MarkSpec.excludes survives save/load: restoring a snapshot-only type
+    must re-register it with the original excludes, or a later
+    register_mark_type with that value would hit the spec-mismatch error
+    (round-3 ADVICE)."""
+    import json
+
+    from peritext_tpu import schema
+
+    _, _, uni = build_session(tmp_path)
+    path = os.path.join(tmp_path, "snap")
+    save_universe(uni, path)
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    comment = next(e for e in sidecar["mark_schema"] if e["name"] == "comment")
+    assert comment["excludes"] == ""
+
+    sidecar["mark_schema"].append(
+        {
+            "name": "ckpt_excl_mark",
+            "inclusive": False,
+            "allow_multiple": True,
+            "attr_keys": ["id"],
+            "excludes": "",
+        }
+    )
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+    try:
+        load_universe(path)
+        assert schema.MARK_SPEC["ckpt_excl_mark"].excludes == ""
+        # Re-registering with the original excludes must be a no-op, not a
+        # spec-mismatch ValueError.
+        schema.register_mark_type(
+            "ckpt_excl_mark",
+            inclusive=False,
+            allow_multiple=True,
+            attr_keys=("id",),
+            excludes="",
+        )
+    finally:
+        schema.MARK_SPEC.pop("ckpt_excl_mark", None)
+        schema._rebuild_views()
+
+
 def test_snapshot_restores_registered_mark_types(tmp_path):
     """A snapshot taken with extra registered types re-registers them on
     load in a process that hasn't registered them."""

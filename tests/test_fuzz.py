@@ -27,6 +27,37 @@ def test_fuzz_nested_objects_converges(seed):
     fuzz(iterations=150, seed=seed, nested=True)
 
 
+def test_fuzz_failure_capture_creates_trace_dir(tmp_path):
+    """Force a real divergence and assert the capture path delivers: fail()
+    assembles a replayable state and save() creates the trace directory
+    (fuzz.ts:16-20 writes traces/fail-*.json; a missing dir must not lose
+    the trace).  Runs unbounded (iterations=0) to prove the while(true)
+    mode terminates via the failure path."""
+    import json
+
+    from peritext_tpu.fuzz import FuzzError
+    from peritext_tpu.oracle import Doc
+
+    class LyingDoc(Doc):
+        # One replica misreports its spans -> guaranteed span divergence at
+        # the first sync between it and an honest replica.
+        def get_text_with_formatting(self, path):
+            spans = super().get_text_with_formatting(path)
+            if self.actor_id == "doc1" and spans:
+                spans = [dict(s, text=s["text"] + "!") for s in spans]
+            return spans
+
+    with pytest.raises(FuzzError) as excinfo:
+        fuzz(iterations=0, seed=3, doc_factory=LyingDoc, check_patches=False)
+    err = excinfo.value
+    path = tmp_path / "no" / "such" / "dir" / "fail-trace.json"
+    err.save(str(path))
+    assert path.exists()
+    loaded = json.loads(path.read_text())
+    # Queues hold every actor that authored a change before the failure.
+    assert loaded["queues"] and set(loaded["queues"]) <= {"doc1", "doc2", "doc3"}
+
+
 def test_fuzz_failure_states_replay(tmp_path):
     """The failure-observability loop: a FuzzError's saved state is a
     replayable change-log trace (the reference's traces/*.json contract)."""

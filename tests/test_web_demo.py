@@ -1,0 +1,22 @@
+"""The browser demo's HTTP Patch protocol, exercised headlessly.
+
+The page (examples/web/index.html) renders from accumulated patches via a JS
+port of test/accumulatePatches.ts; this test drives the same server protocol
+with the Python oracle accumulator standing in for the page."""
+import os
+import subprocess
+import sys
+
+
+def test_web_demo_script_mode():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "examples/web_demo.py", "--script"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "tabs converged via Patch protocol" in proc.stdout
