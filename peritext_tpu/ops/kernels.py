@@ -188,7 +188,7 @@ def _mark_slot_context(state: DocState, op):
     carry = jnp.where(
         (src >= 0)[:, None], state.bnd_mask[jnp.maximum(src, 0)], jnp.uint32(0)
     )
-    return s_slot, e_slot, slots, defined, carry
+    return s_slot, e_slot, slots, defined, carry, src
 
 
 def _apply_mark(state: DocState, op, ranks) -> DocState:
@@ -205,7 +205,13 @@ def _apply_mark(state: DocState, op, ranks) -> DocState:
     the end slot is written (with its carry), the op lands nowhere.
     """
     del ranks
-    s_slot, e_slot, slots, defined, carry = _mark_slot_context(state, op)
+    return _apply_mark_ctx(state, op, _mark_slot_context(state, op))
+
+
+def _apply_mark_ctx(state: DocState, op, ctx) -> DocState:
+    """_apply_mark with a precomputed _mark_slot_context (so a patch-signal
+    computation sharing the same instant can reuse one context)."""
+    s_slot, e_slot, slots, defined, carry, _ = ctx
     m = state.mark_count
     word = m // MASK_WORD_BITS
     bit = jnp.uint32(1) << (m % MASK_WORD_BITS).astype(jnp.uint32)
@@ -278,6 +284,39 @@ apply_ops_batch = jax.jit(apply_ops_vmapped)
 # ---------------------------------------------------------------------------
 
 
+def _walk_signals(ctx, visible, c: int):
+    """written/during/visibleIndex planes of the reference mark walk
+    (peritext.ts:181-214), from a precomputed slot context.  Shared by the
+    interleaved signals and the sorted patch scan so the walk semantics
+    (incl. the s_lt_e/endOfText edge) have exactly one definition."""
+    s_slot, e_slot, slots, defined, _carry, _src = ctx
+    s_lt_e = s_slot < e_slot
+    during = (slots >= s_slot) & (slots < e_slot) & s_lt_e
+    written = (during & ((slots == s_slot) | defined)) | (slots == e_slot)
+    # visibleIndex per slot: before-slot of element i sees the count of
+    # visible elements before i; after-slot sees the count through i.
+    vcum = jnp.cumsum(visible.astype(jnp.int32))
+    vis = jnp.stack([vcum - visible.astype(jnp.int32), vcum], axis=1).reshape(2 * c)
+    final_vis = vcum[c - 1] if c > 0 else jnp.int32(0)
+    return written, during, vis, final_vis
+
+
+def _changed_vs_winner(op, op_rank, w_ctr, w_rank, w_action, w_attr, has_winner):
+    """The `opsToMarks(current) != opsToMarks(new)` test against the op's
+    group winner (reference peritext.ts:294-326 restricted to one group):
+    the op must win the LWW tie-break AND flip the effective value.  One
+    definition shared by both patch paths."""
+    op_wins = ~has_winner | (op[K_CTR] > w_ctr) | (
+        (op[K_CTR] == w_ctr) & (op_rank > w_rank)
+    )
+    old_active = has_winner & (w_action == 0)
+    new_active = op[K_MACTION] == 0
+    value_differs = (old_active != new_active) | (
+        old_active & new_active & (w_attr != op[K_MATTR])
+    )
+    return op_wins & value_differs
+
+
 def _mark_patch_signals(state: DocState, op, ranks, multi):
     """Per-slot patch signals for a mark op (reference peritext.ts:181-214).
 
@@ -297,17 +336,9 @@ def _mark_patch_signals(state: DocState, op, ranks, multi):
     ar = jnp.arange(c, dtype=jnp.int32)
     live = ar < state.length
 
-    s_slot, e_slot, slots, defined, carry = _mark_slot_context(state, op)
-    s_lt_e = s_slot < e_slot
-    during = (slots >= s_slot) & (slots < e_slot) & s_lt_e
-    written = (during & ((slots == s_slot) | defined)) | (slots == e_slot)
-
-    # visibleIndex per slot: before-slot of element i sees the count of
-    # visible elements before i; after-slot sees the count through i.
-    visible = live & ~state.deleted
-    vcum = jnp.cumsum(visible.astype(jnp.int32))
-    vis = jnp.stack([vcum - visible.astype(jnp.int32), vcum], axis=1).reshape(2 * c)
-    final_vis = vcum[c - 1] if c > 0 else jnp.int32(0)
+    ctx = _mark_slot_context(state, op)
+    carry = ctx[4]
+    written, during, vis, final_vis = _walk_signals(ctx, live & ~state.deleted, c)
 
     # Inherited (pre-op) sets at every slot, as presence bits.
     present = expand_mask_bits(carry, state.max_mark_ops)  # [2C, M]
@@ -335,17 +366,9 @@ def _mark_patch_signals(state: DocState, op, ranks, multi):
     w_ctr = jnp.where(has_winner, max_ctr, jnp.int32(-1))
     w_rank = jnp.where(has_winner, max_rank, jnp.int32(-1))
 
-    op_rank = ranks[op[K_ACT]]
-    op_wins = ~has_winner | (op[K_CTR] > w_ctr) | (
-        (op[K_CTR] == w_ctr) & (op_rank > w_rank)
+    changed = _changed_vs_winner(
+        op, ranks[op[K_ACT]], w_ctr, w_rank, w_action, w_attr, has_winner
     )
-    old_active = has_winner & (w_action == 0)
-    new_active = op[K_MACTION] == 0
-    value_differs = (old_active != new_active) | (
-        old_active & new_active & (w_attr != op[K_MATTR])
-    )
-    changed = op_wins & value_differs
-
     return written, during, changed, vis, final_vis
 
 
@@ -1383,6 +1406,120 @@ def _sorted_def_first(bnd_def0, mark_ops, elem_ctr, elem_act, length):
     return jnp.where(bnd_def0, jnp.int32(-1), first)
 
 
+# Max columns of one allowMultiple resolution group (same (type, attr-id) —
+# in practice the add and removes of one comment id) the cached patch scan
+# resolves exactly.  The universe checks group sizes host-side and falls
+# back to the interleaved scan when exceeded, so the cap never silently
+# changes results.  Read at import time, like PERITEXT_SPLICE.
+PATCH_GROUP_K = int(os.environ.get("PERITEXT_PATCH_GROUP_K", "32"))
+
+
+def _winner_cache_init(bnd_mask0, mark_cols, ranks, n_types, max_mark_ops, multi):
+    """Per-slot per-type LWW winners of the pre-batch boundary rows.
+
+    The patch scan's ``changed`` signal needs, per mark op, the winner of
+    the op's own resolution group within the inherited set at each written
+    slot (opsToMarks restricted to one group, peritext.ts:294-326).  For
+    non-allowMultiple types the group is the TYPE, so the winner is a
+    per-slot per-type quantity — cacheable as [2C, T, 4] (ctr, rank,
+    action, attr; ctr=-1 empty) and maintainable through the scan with the
+    same carry gathers _apply_mark already does.
+
+    Resolution is ONE dominance matmul for all types at once (the
+    resolve_winners trick — a [2C, M] x [M, M] MXU pass), not a per-type
+    loop of [2C, M] reductions; with the padded type registry (T=16) the
+    loop form materializes ~100 [2C, M] planes per replica and dominates
+    the whole merge.  Winner VALUES are recovered via an index matmul
+    (win @ one-hot·(index+1), exact in f32 since indices < 2^24) followed
+    by [2C, T] gathers — never by summing raw field values through f32.
+    Entries for allowMultiple types are unused (their groups are per-attr;
+    the scan resolves them over compacted columns).
+    """
+    mark_ctr, mark_act, mark_action, mark_type, mark_attr = mark_cols
+    m_cap = mark_ctr.shape[0]
+    present = expand_mask_bits(bnd_mask0, max_mark_ops)  # [2C, M] bool
+    rank = ranks[mark_act]
+    type_c = jnp.clip(mark_type, 0, n_types - 1)
+    nm_col = ~multi[type_c]  # non-allowMultiple columns
+
+    same_type = mark_type[:, None] == mark_type[None, :]
+    key_gt = (mark_ctr[None, :] > mark_ctr[:, None]) | (
+        (mark_ctr[None, :] == mark_ctr[:, None]) & (rank[None, :] > rank[:, None])
+    )
+    dom = same_type & key_gt & nm_col[:, None] & nm_col[None, :]
+    # dom_count[p, n] = #present dominators of column n at slot p.
+    dom_count = jnp.einsum(
+        "pm,nm->pn", present.astype(jnp.float32), dom.astype(jnp.float32)
+    )
+    win = present & nm_col[None, :] & (dom_count < 0.5)  # one-hot per (slot, type)
+
+    onehot = (
+        (type_c[:, None] == jnp.arange(n_types, dtype=jnp.int32)[None, :])
+        & nm_col[:, None]
+    ).astype(jnp.float32)  # [M, T]
+    col_plus1 = (jnp.arange(m_cap, dtype=jnp.int32) + 1).astype(jnp.float32)
+    # Precision.HIGHEST: TPU default matmul precision feeds bf16 operands
+    # to the MXU, and column indices above 256 are not bf16-representable —
+    # the recovered winner column would silently drift.  (The dominance
+    # einsum above is safe at any precision: 0/1 operands, f32 accumulate.)
+    widx = (
+        jnp.round(
+            jnp.matmul(
+                win.astype(jnp.float32),
+                onehot * col_plus1[:, None],
+                precision=lax.Precision.HIGHEST,
+            )
+        ).astype(jnp.int32)
+        - 1
+    )  # [2C, T]: winner column, -1 when none
+    has = widx >= 0
+    wc = jnp.maximum(widx, 0)
+    return jnp.where(
+        has[:, :, None],
+        jnp.stack(
+            [mark_ctr[wc], rank[wc], mark_action[wc], mark_attr[wc]], axis=-1
+        ),
+        jnp.array([-1, -1, 0, 0], jnp.int32)[None, None, :],
+    )  # [2C, T, 4]
+
+
+def _group_topk_cols(mark_type_col, mark_attr_col, op, k: int):
+    """Indices of up to ``k`` mark-table columns in op's (type, attr) group
+    (exhaustive when the host-verified group size is <= k), plus validity."""
+    match = (mark_type_col == op[K_MTYPE]) & (mark_attr_col == op[K_MATTR])
+    vals, cols = lax.top_k(match.astype(jnp.int32), k)
+    return cols.astype(jnp.int32), vals > 0
+
+
+def _winner_over_cols(carry, cols, col_ok, mark_cols, ranks):
+    """LWW winner per slot among the given table columns present in the
+    carry rows: [2C, K] work instead of [2C, M]."""
+    mark_ctr, mark_act, mark_action, _mark_type, mark_attr = mark_cols
+    words = (cols // MASK_WORD_BITS).astype(jnp.int32)
+    bits = (cols % MASK_WORD_BITS).astype(jnp.uint32)
+    pres = (jnp.take(carry, words, axis=1) >> bits[None, :]) & jnp.uint32(1)
+    cand = pres.astype(bool) & col_ok[None, :]  # [2C, K]
+    g_ctr = mark_ctr[cols]
+    g_rank = ranks[mark_act[cols]]
+    neg = jnp.int32(-(2**31) + 1)
+    ctrs = jnp.where(cand, g_ctr[None, :], neg)
+    max_ctr = jnp.max(ctrs, axis=1)
+    tie = cand & (g_ctr[None, :] == max_ctr[:, None])
+    rks = jnp.where(tie, g_rank[None, :], neg)
+    max_rank = jnp.max(rks, axis=1)
+    win = tie & (g_rank[None, :] == max_rank[:, None])
+    has = cand.any(axis=1)
+    w_action = jnp.sum(jnp.where(win, mark_action[cols][None, :], 0), axis=1)
+    w_attr = jnp.sum(jnp.where(win, mark_attr[cols][None, :], 0), axis=1)
+    return (
+        jnp.where(has, max_ctr, jnp.int32(-1)),
+        jnp.where(has, max_rank, jnp.int32(-1)),
+        w_action,
+        w_attr,
+        has,
+    )
+
+
 def merge_step_sorted_patched(
     state: DocState,
     text_ops: jax.Array,
@@ -1395,6 +1532,7 @@ def merge_step_sorted_patched(
     text_time: jax.Array,
     mark_time: jax.Array,
     maxk: int,
+    has_marks: bool = True,
 ):
     """Sorted merge that also emits per-op patch records.
 
@@ -1461,8 +1599,59 @@ def merge_step_sorted_patched(
     acc0 = jnp.zeros((text_ops.shape[0], w), jnp.uint32)
     m_idx0 = jnp.arange(mark_ops.shape[0], dtype=jnp.int32)
 
+    # Per-slot per-type winner cache: the scan's `changed` signal resolves
+    # the op's group winner from this [2C, T, 4] cache (non-allowMultiple)
+    # or a K-compacted column subset (allowMultiple; host-gated to the
+    # interleaved fallback when a group exceeds PATCH_GROUP_K) instead of
+    # expanding a [2C, M] presence plane per step — the patched path's
+    # dominant traffic (PROFILE_r04.md item 3).
+    mcols_final = (mark_ctr_f, mark_act_f, mark_action_f, mark_type_f, mark_attr_f)
+    n_types = multi.shape[0]
+
+    if not has_marks:
+        # Static no-marks fast path (the common pure-typing batch, chosen
+        # by the universe from the encoded rows): boundary planes never
+        # evolve, so inserts inherit straight from the pre-scan planes and
+        # the winner-cache init + mark scan compile away entirely.
+        rows0 = bnd_mask0[src_c]
+        ins_mask = jnp.where((src_ok)[:, None], rows0, jnp.uint32(0))
+        m_pad = mark_ops.shape[0]
+        new_state = DocState(
+            elem_ctr=elem_ctr,
+            elem_act=elem_act,
+            deleted=deleted,
+            chars=chars,
+            bnd_def=bnd_def0,
+            bnd_mask=bnd_mask0,
+            mark_ctr=mark_ctr_f,
+            mark_act=mark_act_f,
+            mark_action=mark_action_f,
+            mark_type=mark_type_f,
+            mark_attr=mark_attr_f,
+            length=length,
+            mark_count=mark_count_f,
+        )
+        records = {
+            "kind": kind_t,
+            "tvalid": tvalid,
+            "index0": index0,
+            "ins_mask": ins_mask,
+            "written": jnp.zeros((m_pad, 2 * c), bool),
+            "during": jnp.zeros((m_pad, 2 * c), bool),
+            "changed": jnp.zeros((m_pad, 2 * c), bool),
+            "vis": jnp.zeros((m_pad, 2 * c), jnp.int32),
+            "obj_len": jnp.zeros((m_pad,), jnp.int32),
+        }
+        return new_state, records
+
+    wcache0 = _winner_cache_init(
+        bnd_mask0, mcols_final, ranks, n_types, state.max_mark_ops, multi
+    )
+    ar_c = jnp.arange(c, dtype=jnp.int32)
+    empty_wc = jnp.array([-1, -1, 0, 0], jnp.int32)
+
     def step(carry, xs):
-        bnd_def, bnd_mask, acc = carry
+        bnd_def, bnd_mask, acc, wcache = carry
         op, m_idx, t_m = xs
         # Inserts whose instant lands at this plane version read their
         # inherited row before this mark writes.  (Valid mark rows are a
@@ -1472,13 +1661,13 @@ def merge_step_sorted_patched(
         take = src_ok & (tm == m_idx)
         acc = acc | jnp.where(take[:, None], rows, jnp.uint32(0))
 
-        # Faithful per-op signals + application on a synthetic state view:
-        # final text plane with visibility masked to this instant, evolving
-        # boundary planes, final mark table truncated by mark_count.
+        # Synthetic state view: final text plane with visibility masked to
+        # this instant, evolving boundary planes, final mark table.
+        st_deleted = ~((born < t_m) & (died > t_m))
         st = DocState(
             elem_ctr=elem_ctr,
             elem_act=elem_act,
-            deleted=~((born < t_m) & (died > t_m)),
+            deleted=st_deleted,
             chars=chars,
             bnd_def=bnd_def,
             bnd_mask=bnd_mask,
@@ -1491,12 +1680,63 @@ def merge_step_sorted_patched(
             mark_count=state.mark_count + m_idx,
         )
         valid = op[K_KIND] == KIND_MARK
-        written, during, changed, vis, final_vis = _mark_patch_signals(
-            st, op, ranks, multi
+        ctx = _mark_slot_context(st, op)
+        carry_rows, src = ctx[4], ctx[5]
+        written, during, vis, final_vis = _walk_signals(
+            ctx, (ar_c < length) & ~st_deleted, c
         )
-        new_st = _apply_mark(st, op, ranks)
+
+        # `changed`: winner of the op's resolution group within the
+        # inherited set, from the cache (LWW-per-type) or the compacted
+        # group columns (allowMultiple).
+        src_ok_slot = src >= 0
+        srcc = jnp.maximum(src, 0)
+        wc_carry = jnp.where(
+            src_ok_slot[:, None, None], wcache[srcc], empty_wc[None, None, :]
+        )  # [2C, T, 4]
+        wnm = jnp.take(wc_carry, jnp.clip(op[K_MTYPE], 0, n_types - 1), axis=1)
+        cols, col_ok = _group_topk_cols(mark_type_f, mark_attr_f, op, PATCH_GROUP_K)
+        g_ctr, g_rank, g_action, g_attr, g_has = _winner_over_cols(
+            carry_rows, cols, col_ok, mcols_final, ranks
+        )
+        is_multi_op = multi[jnp.clip(op[K_MTYPE], 0, n_types - 1)]
+        w_ctr = jnp.where(is_multi_op, g_ctr, wnm[:, 0])
+        w_rank = jnp.where(is_multi_op, g_rank, wnm[:, 1])
+        w_action = jnp.where(is_multi_op, g_action, wnm[:, 2])
+        w_attr = jnp.where(is_multi_op, g_attr, wnm[:, 3])
+        has_winner = jnp.where(is_multi_op, g_has, wnm[:, 0] >= 0)
+
+        op_rank = ranks[op[K_ACT]]
+        changed = _changed_vs_winner(
+            op, op_rank, w_ctr, w_rank, w_action, w_attr, has_winner
+        )
+
+        new_st = _apply_mark_ctx(st, op, ctx)
         bnd_def = jnp.where(valid, new_st.bnd_def, bnd_def)
         bnd_mask = jnp.where(valid, new_st.bnd_mask, bnd_mask)
+
+        # Cache maintenance mirrors _apply_mark's write classes: written
+        # slots take their carry's winners, with the op merged into its own
+        # type's entry where its bit lands (in-range) and it beats the
+        # carried winner.  allowMultiple ops join rows but never affect a
+        # per-type LWW entry.
+        in_range = during
+        write = written
+        t_oh = jnp.arange(n_types, dtype=jnp.int32) == op[K_MTYPE]
+        beats_nm = (wnm[:, 0] < 0) | (op[K_CTR] > wnm[:, 0]) | (
+            (op[K_CTR] == wnm[:, 0]) & (op_rank > wnm[:, 1])
+        )
+        op_vals = jnp.stack(
+            [op[K_CTR], op_rank, op[K_MACTION], op[K_MATTR]]
+        ).astype(jnp.int32)
+        upd = jnp.where(
+            t_oh[None, :, None]
+            & ((~is_multi_op) & in_range & beats_nm)[:, None, None],
+            op_vals[None, None, :],
+            wc_carry,
+        )
+        wcache = jnp.where((write & valid)[:, None, None], upd, wcache)
+
         rec = {
             "written": written & valid,
             "during": during & valid,
@@ -1504,10 +1744,10 @@ def merge_step_sorted_patched(
             "vis": vis,
             "obj_len": final_vis,
         }
-        return (bnd_def, bnd_mask, acc), rec
+        return (bnd_def, bnd_mask, acc, wcache), rec
 
-    (bnd_def, bnd_mask, acc), mrec = lax.scan(
-        step, (bnd_def0, bnd_mask0, acc0), (mark_ops, m_idx0, mark_time)
+    (bnd_def, bnd_mask, acc, _), mrec = lax.scan(
+        step, (bnd_def0, bnd_mask0, acc0, wcache0), (mark_ops, m_idx0, mark_time)
     )
     # Inserts after every mark instant read the final planes.
     rows = bnd_mask[src_c]
@@ -1544,10 +1784,12 @@ def merge_step_sorted_patched(
 
 
 @functools.lru_cache(maxsize=None)
-def _merge_step_sorted_patched_batch(maxk: int):
+def _merge_step_sorted_patched_batch(maxk: int, has_marks: bool):
     return jax.jit(
         jax.vmap(
-            functools.partial(merge_step_sorted_patched, maxk=maxk),
+            functools.partial(
+                merge_step_sorted_patched, maxk=maxk, has_marks=has_marks
+            ),
             in_axes=(0, 0, 0, None, 0, None, 0, None, 0, 0),
         )
     )
@@ -1565,9 +1807,14 @@ def merge_step_sorted_patched_batch(
     text_time,
     mark_time,
     maxk: int,
+    has_marks: bool = True,
 ):
-    """Jitted batched entry point for the patch-emitting sorted merge."""
-    fn = _merge_step_sorted_patched_batch(maxk)
+    """Jitted batched entry point for the patch-emitting sorted merge.
+
+    ``has_marks=False`` (static, from the encoded batch) compiles the
+    mark-free fast path: no winner-cache init, no mark scan.
+    """
+    fn = _merge_step_sorted_patched_batch(maxk, has_marks)
     return fn(
         states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks,
         char_buf, multi, text_time, mark_time,

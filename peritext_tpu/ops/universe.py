@@ -21,7 +21,7 @@ import json
 import math
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -275,6 +275,41 @@ def assemble_patches_sorted(
     return patches
 
 
+def fold_multi_groups(
+    census: Dict[Tuple[int, int], set],
+    *,
+    types,
+    attr_ids,
+    ctrs,
+    act_ids,
+) -> None:
+    """Fold mark-op columns into an allowMultiple group census:
+    census[(type_id, attr_id)] accumulates distinct (ctr, act_id) op
+    identities.  THE one definition of group identity — the live ingest
+    census, the pre-launch overflow gate, and the checkpoint rebuild all
+    fold through here, so they can never disagree."""
+    multi_by_id = schema.ALLOW_MULTIPLE_BY_ID
+    for t, attr, ctr, act in zip(types, attr_ids, ctrs, act_ids):
+        t = int(t)
+        if t < len(multi_by_id) and multi_by_id[t]:
+            census.setdefault((t, int(attr)), set()).add((int(ctr), int(act)))
+
+
+def fold_multi_group_rows(census: Dict[Tuple[int, int], set], rows) -> None:
+    """fold_multi_groups over encoded op rows (mark rows only)."""
+    rows = np.asarray(rows)
+    if rows.size == 0:
+        return
+    marks = rows[rows[:, K.K_KIND] == K.KIND_MARK]
+    fold_multi_groups(
+        census,
+        types=marks[:, K.K_MTYPE],
+        attr_ids=marks[:, K.K_MATTR],
+        ctrs=marks[:, K.K_CTR],
+        act_ids=marks[:, K.K_ACT],
+    )
+
+
 class TpuUniverse:
     def __init__(
         self,
@@ -309,6 +344,13 @@ class TpuUniverse:
         self.store_versions: List[int] = [0] * len(self.replica_ids)
         self._store_version_counter = 0
         self.text_objs: List[Optional[str]] = [None] * len(self.replica_ids)
+        # Distinct mark ops per allowMultiple resolution group ((type_id,
+        # attr_id) -> {(ctr, act_id)}), unioned over every ingested change.
+        # A conservative upper bound on any replica's per-group column
+        # count, used to gate the cached patch scan (which resolves multi
+        # groups over at most kernels.PATCH_GROUP_K columns) to the exact
+        # interleaved fallback when a group grows past the cap.
+        self._multi_groups: Dict[Tuple[int, int], set] = {}
         # Lightweight observability counters (the reference's observability
         # is console logging + the demo op panel, SURVEY §5; at batch scale
         # these are what perf debugging needs).
@@ -605,6 +647,26 @@ class TpuUniverse:
         sizes = np.bincount(group_of, minlength=len(groups))
         dupes = np.asarray([g["dupes"] for g in groups], np.int64)
         self.stats["duplicates_dropped"] += int((dupes * sizes).sum())
+        for g in groups:
+            self._count_multi_groups(g["rows"])
+
+    def _count_multi_groups(self, rows: np.ndarray) -> None:
+        """Fold a batch's allowMultiple mark rows into _multi_groups."""
+        fold_multi_group_rows(self._multi_groups, rows)
+
+    def _multi_group_overflow(self, extra_rows: List[np.ndarray], cap: int) -> bool:
+        """Would any allowMultiple group exceed ``cap`` distinct ops once
+        ``extra_rows`` land?  (Conservative: unioned over all replicas.)"""
+        pending: Dict[Tuple[int, int], set] = {}
+        for rows in extra_rows:
+            fold_multi_group_rows(pending, rows)
+        # Only groups this batch actually resolves matter: the cached scan
+        # compacts columns per *batch* multi op, so untargeted groups can
+        # grow past the cap without affecting correctness.
+        return any(
+            len(ops | self._multi_groups.get(key, set())) > cap
+            for key, ops in pending.items()
+        )
 
     # -- ingestion ----------------------------------------------------------
 
@@ -810,6 +872,14 @@ class TpuUniverse:
             if sorted_prep["fell_back"]:
                 use_scan = True
                 self.stats["scan_fallbacks"] += 1
+            elif self._multi_group_overflow(mark_rows_list, K.PATCH_GROUP_K):
+                # The cached patch scan resolves allowMultiple groups over
+                # at most PATCH_GROUP_K compacted columns; a larger group
+                # must take the exact interleaved path.
+                use_scan = True
+                self.stats["multi_group_fallbacks"] = (
+                    self.stats.get("multi_group_fallbacks", 0) + 1
+                )
         if not use_scan:
             return self._patched_sorted(
                 prep,
@@ -925,6 +995,10 @@ class TpuUniverse:
         n = len(self.replica_ids)
         chunk = self._patch_chunk(n)
         prev_states = self.states
+        # Static mark-free fast path: a pure-typing batch (no real mark
+        # rows anywhere) compiles without the winner-cache init or the
+        # mark scan.
+        has_marks = any(m.shape[0] for m in mark_rows_list)
         try:
             state_slices = []
             record_chunks: List[Dict[str, np.ndarray]] = []
@@ -943,6 +1017,7 @@ class TpuUniverse:
                     jax.numpy.asarray(text_pos[sl]),
                     jax.numpy.asarray(mark_pos[sl]),
                     sorted_prep["maxk"],
+                    has_marks=has_marks,
                 )
                 state_slices.append(st)
                 record_chunks.append({k: np.asarray(v) for k, v in records.items()})

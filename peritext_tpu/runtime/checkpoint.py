@@ -172,6 +172,19 @@ def load_universe(path: str) -> TpuUniverse:
 
     data = np.load(path + ".npz")
     uni.states = DocState(**{f: jax.numpy.asarray(data[f]) for f in _STATE_FIELDS})
+    # Rebuild the allowMultiple group census (gates the cached patch scan)
+    # from the restored mark tables.
+    from peritext_tpu.ops.universe import fold_multi_groups
+
+    for r in range(len(uni.replica_ids)):
+        count = uni.mark_counts[r]
+        fold_multi_groups(
+            uni._multi_groups,
+            types=data["mark_type"][r][:count],
+            attr_ids=data["mark_attr"][r][:count],
+            ctrs=data["mark_ctr"][r][:count],
+            act_ids=data["mark_act"][r][:count],
+        )
     return uni
 
 

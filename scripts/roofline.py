@@ -237,6 +237,20 @@ def main() -> int:
         ).lower(st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos).compile()
         report("merge_step_sorted_patched @bench", patched, per_chip_ops)
 
+    if want("patched_nomarks"):
+        from peritext_tpu.schema import allow_multiple_array
+
+        multi = sds(allow_multiple_array(), repl)
+        tpos = sds(np.zeros(sp["text"].shape[:2], np.int32), row)
+        mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
+        patched_nm = jax.jit(
+            lambda st, t, ro, m, rk, b, mu, tp, mp: K.merge_step_sorted_patched_batch(
+                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
+                has_marks=False,
+            )
+        ).lower(st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos).compile()
+        report("merge_step_sorted_patched @bench (no-marks fast path)", patched_nm, per_chip_ops)
+
     if not want("latency"):
         return 0
 

@@ -264,3 +264,69 @@ def test_sorted_patch_path_matches_scan_random(seed):
     assert sorted_out["late"] == scan_out["late"]
     assert sorted_spans == scan_spans
     assert sorted_spans[0] == oracle.get_text_with_formatting(["text"])
+
+
+def test_multi_group_overflow_falls_back_to_scan():
+    """An allowMultiple group larger than PATCH_GROUP_K (many ops on ONE
+    comment id) must route to the exact interleaved path — and still emit
+    the oracle's byte-identical stream."""
+    from peritext_tpu.ops import kernels as K
+
+    docs, _, initial_change = generate_docs("commented text here")
+    doc = docs[0]
+    stream = [initial_change]
+    # K+1 distinct ops in the (comment, 'hot') group: alternating add/remove.
+    for i in range(K.PATCH_GROUP_K + 1):
+        action = "addMark" if i % 2 == 0 else "removeMark"
+        change, _ = doc.change(
+            [
+                {
+                    "path": ["text"],
+                    "action": action,
+                    "startIndex": i % 5,
+                    "endIndex": 6 + (i % 4),
+                    "markType": "comment",
+                    "attrs": {"id": "hot"},
+                }
+            ]
+        )
+        stream.append(change)
+
+    oracle = Doc("observer")
+    oracle_patches = []
+    for change in stream:
+        oracle_patches.extend(oracle.apply_change(change))
+
+    uni = TpuUniverse(["observer"])
+    engine_patches = uni.apply_changes_with_patches({"observer": stream})["observer"]
+    assert uni.stats.get("multi_group_fallbacks", 0) > 0, "gate never fired"
+    assert engine_patches == oracle_patches
+    assert uni.spans("observer") == oracle.get_text_with_formatting(["text"])
+
+    # Under the cap the sorted path keeps serving (fresh universe, fresh
+    # group census): same ops spread over DISTINCT ids -> no fallback.
+    docs2, _, genesis2 = generate_docs("commented text here")
+    doc2 = docs2[0]
+    stream2 = [genesis2]
+    for i in range(K.PATCH_GROUP_K + 1):
+        change, _ = doc2.change(
+            [
+                {
+                    "path": ["text"],
+                    "action": "addMark",
+                    "startIndex": i % 5,
+                    "endIndex": 6 + (i % 4),
+                    "markType": "comment",
+                    "attrs": {"id": f"c{i}"},
+                }
+            ]
+        )
+        stream2.append(change)
+    oracle2 = Doc("observer")
+    oracle2_patches = []
+    for change in stream2:
+        oracle2_patches.extend(oracle2.apply_change(change))
+    uni2 = TpuUniverse(["observer"], max_mark_ops=128)
+    engine2 = uni2.apply_changes_with_patches({"observer": stream2})["observer"]
+    assert uni2.stats.get("multi_group_fallbacks", 0) == 0
+    assert engine2 == oracle2_patches
