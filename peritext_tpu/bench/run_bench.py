@@ -105,6 +105,10 @@ def main() -> None:
             result["patched_ops_per_sec"] = round(p["ops_per_sec"], 1)
             result["patched_replicas"] = p["replicas"]
             result["patched_path"] = p["path"]
+            # The common pure-typing ingest (no mark rows): compiles the
+            # static mark-free fast path, no winner-cache init or scan.
+            p_typing = time_patched_merge(with_marks=False)
+            result["patched_typing_ops_per_sec"] = round(p_typing["ops_per_sec"], 1)
             if patches_mode == "ab":
                 p_scan = time_patched_merge(force_scan=True)
                 result["patched_scan_ops_per_sec"] = round(p_scan["ops_per_sec"], 1)

@@ -156,10 +156,11 @@ def _apply_delete(state: DocState, op, ranks) -> DocState:
 def _mark_slot_context(state: DocState, op):
     """Shared boundary-slot context for mark application and patch signals.
 
-    Returns (s_slot, e_slot, slots, defined, carry) where carry[p] is the
-    nearest pre-op defined set at or left of p (the walk's currentOps,
-    peritext.ts:181-186).  Shared so the patch signals can never
-    desynchronize from the state the op actually writes.
+    Returns (s_slot, e_slot, slots, defined, carry, src) where carry[p] is
+    the nearest pre-op defined set at or left of p (the walk's currentOps,
+    peritext.ts:181-186) and src[p] is that set's slot index (-1: none —
+    the winner cache gathers through it).  Shared so the patch signals can
+    never desynchronize from the state the op actually writes.
     """
     c = state.capacity
     big = jnp.int32(2 * c + 2)
@@ -1487,7 +1488,9 @@ def _group_topk_cols(mark_type_col, mark_attr_col, op, k: int):
     """Indices of up to ``k`` mark-table columns in op's (type, attr) group
     (exhaustive when the host-verified group size is <= k), plus validity."""
     match = (mark_type_col == op[K_MTYPE]) & (mark_attr_col == op[K_MATTR])
-    vals, cols = lax.top_k(match.astype(jnp.int32), k)
+    # A group can never exceed the table itself; clamping keeps an oversized
+    # PERITEXT_PATCH_GROUP_K from making top_k request more lanes than exist.
+    vals, cols = lax.top_k(match.astype(jnp.int32), min(k, match.shape[0]))
     return cols.astype(jnp.int32), vals > 0
 
 

@@ -271,6 +271,7 @@ def test_multi_group_overflow_falls_back_to_scan():
     comment id) must route to the exact interleaved path — and still emit
     the oracle's byte-identical stream."""
     from peritext_tpu.ops import kernels as K
+    from peritext_tpu.testing import patch_path_env
 
     docs, _, initial_change = generate_docs("commented text here")
     doc = docs[0]
@@ -297,8 +298,13 @@ def test_multi_group_overflow_falls_back_to_scan():
     for change in stream:
         oracle_patches.extend(oracle.apply_change(change))
 
-    uni = TpuUniverse(["observer"])
-    engine_patches = uni.apply_changes_with_patches({"observer": stream})["observer"]
+    # Clear any ambient scan-forcing (the CI scan-forced leg) — the gate
+    # under test only runs when the sorted path is reachable at all.
+    with patch_path_env(None):
+        uni = TpuUniverse(["observer"])
+        engine_patches = uni.apply_changes_with_patches({"observer": stream})[
+            "observer"
+        ]
     assert uni.stats.get("multi_group_fallbacks", 0) > 0, "gate never fired"
     assert engine_patches == oracle_patches
     assert uni.spans("observer") == oracle.get_text_with_formatting(["text"])
@@ -326,7 +332,8 @@ def test_multi_group_overflow_falls_back_to_scan():
     oracle2_patches = []
     for change in stream2:
         oracle2_patches.extend(oracle2.apply_change(change))
-    uni2 = TpuUniverse(["observer"], max_mark_ops=128)
-    engine2 = uni2.apply_changes_with_patches({"observer": stream2})["observer"]
+    with patch_path_env(None):
+        uni2 = TpuUniverse(["observer"], max_mark_ops=128)
+        engine2 = uni2.apply_changes_with_patches({"observer": stream2})["observer"]
     assert uni2.stats.get("multi_group_fallbacks", 0) == 0
     assert engine2 == oracle2_patches
