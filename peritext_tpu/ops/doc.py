@@ -336,6 +336,9 @@ class TpuDoc:
             jax.numpy.asarray(allow_multiple_array()),
         )
         uni.states = stack_states([new_state])
+        # The local interleaved application rewrites boundary rows without
+        # maintaining the patched sorted merge's winner cache.
+        uni._wcaches = None
         records = {k: np.asarray(v)[None] for k, v in records.items()}
         table = uni._mark_op_table(new_state)
         return assemble_patches(records, 0, op_rows, table, uni.attrs)

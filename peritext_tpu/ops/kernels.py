@@ -1483,6 +1483,21 @@ def _winner_cache_init(bnd_mask0, mark_cols, ranks, n_types, max_mark_ops, multi
     )  # [2C, T, 4]
 
 
+def _permute_wcache(wcache, orig_idx):
+    """Re-align a [2C, T, 4] winner cache after a text phase, mirroring
+    _permute_boundaries: batch-born elements' slots come up empty."""
+    c = orig_idx.shape[0]
+    valid = orig_idx >= 0
+    safe = jnp.maximum(orig_idx, 0)
+    wc2 = wcache.reshape(c, 2, wcache.shape[-2], 4)
+    out = jnp.where(
+        valid[:, None, None, None],
+        wc2[safe],
+        jnp.array([-1, -1, 0, 0], jnp.int32)[None, None, None, :],
+    )
+    return out.reshape(2 * c, wcache.shape[-2], 4)
+
+
 def _group_topk_cols(mark_type_col, mark_attr_col, op, k: int):
     """Indices of up to ``k`` mark-table columns in op's (type, attr) group
     (exhaustive when the host-verified group size is <= k), plus validity."""
@@ -1535,6 +1550,7 @@ def merge_step_sorted_patched(
     mark_time: jax.Array,
     maxk: int,
     has_marks: bool = True,
+    wcache_in: jax.Array | None = None,
 ):
     """Sorted merge that also emits per-op patch records.
 
@@ -1545,6 +1561,17 @@ def merge_step_sorted_patched(
     only the batch's mark rows.  ``text_time`` / ``mark_time`` are each
     row's flat delivery-stream position (encode row_pos; a fused run's
     first char), padded with a beyond-any-instant sentinel.
+
+    ``wcache_in`` (optional [2C, T, 4], PRE-placement slot coordinates):
+    the persisted per-slot per-type winner cache from the previous patched
+    merge — the universe threads it between ingests so the [2C, M]
+    dominance init amortizes to ONE launch per universe lifetime in an
+    all-patched (editor-fleet) workload.  It is derived state: exactly the
+    cache a fresh init over the same boundary rows would produce
+    (tests assert this), permuted alongside the boundary planes here.
+    Returns ``(new_state, records)``; records carry ``wcache`` (final,
+    post-batch coordinates) for the universe to persist — except on the
+    cacheless mark-free path, which neither needs nor produces one.
     """
     elem_ctr, elem_act, deleted, chars, orig_idx, length = place_text_batch(
         state.elem_ctr,
@@ -1644,10 +1671,18 @@ def merge_step_sorted_patched(
             "vis": jnp.zeros((m_pad, 2 * c), jnp.int32),
             "obj_len": jnp.zeros((m_pad,), jnp.int32),
         }
+        if wcache_in is not None:
+            # Rows didn't evolve; the persisted cache stays valid once
+            # realigned to the new slot coordinates.
+            records["wcache"] = _permute_wcache(wcache_in, orig_idx)
         return new_state, records
 
-    wcache0 = _winner_cache_init(
-        bnd_mask0, mcols_final, ranks, n_types, state.max_mark_ops, multi
+    wcache0 = (
+        _permute_wcache(wcache_in, orig_idx)
+        if wcache_in is not None
+        else _winner_cache_init(
+            bnd_mask0, mcols_final, ranks, n_types, state.max_mark_ops, multi
+        )
     )
     ar_c = jnp.arange(c, dtype=jnp.int32)
     empty_wc = jnp.array([-1, -1, 0, 0], jnp.int32)
@@ -1748,7 +1783,7 @@ def merge_step_sorted_patched(
         }
         return (bnd_def, bnd_mask, acc, wcache), rec
 
-    (bnd_def, bnd_mask, acc, _), mrec = lax.scan(
+    (bnd_def, bnd_mask, acc, wcache_f), mrec = lax.scan(
         step, (bnd_def0, bnd_mask0, acc0, wcache0), (mark_ops, m_idx0, mark_time)
     )
     # Inserts after every mark instant read the final planes.
@@ -1781,12 +1816,25 @@ def merge_step_sorted_patched(
         "changed": mrec["changed"],
         "vis": mrec["vis"],
         "obj_len": mrec["obj_len"],
+        # Post-batch winner cache, persisted by the universe so the next
+        # patched merge skips the dominance init.
+        "wcache": wcache_f,
     }
     return new_state, records
 
 
 @functools.lru_cache(maxsize=None)
-def _merge_step_sorted_patched_batch(maxk: int, has_marks: bool):
+def _merge_step_sorted_patched_batch(maxk: int, has_marks: bool, has_wcache: bool):
+    if has_wcache:
+        def call(st, t, ro, nr, m, rk, b, mu, tt, mt, wc):
+            return merge_step_sorted_patched(
+                st, t, ro, nr, m, rk, b, mu, tt, mt,
+                maxk=maxk, has_marks=has_marks, wcache_in=wc,
+            )
+
+        return jax.jit(
+            jax.vmap(call, in_axes=(0, 0, 0, None, 0, None, 0, None, 0, 0, 0))
+        )
     return jax.jit(
         jax.vmap(
             functools.partial(
@@ -1810,17 +1858,23 @@ def merge_step_sorted_patched_batch(
     mark_time,
     maxk: int,
     has_marks: bool = True,
+    wcache_in=None,
 ):
     """Jitted batched entry point for the patch-emitting sorted merge.
 
     ``has_marks=False`` (static, from the encoded batch) compiles the
     mark-free fast path: no winner-cache init, no mark scan.
+    ``wcache_in`` ([R, 2C, T, 4]) threads the persisted winner cache; when
+    given, the marked path compiles WITHOUT the dominance init.
     """
-    fn = _merge_step_sorted_patched_batch(maxk, has_marks)
-    return fn(
+    fn = _merge_step_sorted_patched_batch(maxk, has_marks, wcache_in is not None)
+    args = [
         states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks,
         char_buf, multi, text_time, mark_time,
-    )
+    ]
+    if wcache_in is not None:
+        args.append(wcache_in)
+    return fn(*args)
 
 
 def flatten_sources(state: DocState):

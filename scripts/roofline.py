@@ -251,6 +251,30 @@ def main() -> int:
         ).lower(st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos).compile()
         report("merge_step_sorted_patched @bench (no-marks fast path)", patched_nm, per_chip_ops)
 
+    if want("patched_threaded"):
+        from peritext_tpu.schema import allow_multiple_array as _ama
+
+        multi = sds(_ama(), repl)
+        tpos = sds(np.zeros(sp["text"].shape[:2], np.int32), row)
+        mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
+        n_types = int(np.asarray(_ama()).shape[0])
+        wc = sds(
+            np.zeros((R, 2 * capacity, n_types, 4), np.int32), row
+        )
+        threaded = jax.jit(
+            lambda st, t, ro, m, rk, b, mu, tp, mp, w: K.merge_step_sorted_patched_batch(
+                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
+                wcache_in=w,
+            )
+        ).lower(
+            st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos, wc
+        ).compile()
+        report(
+            "merge_step_sorted_patched @bench (threaded cache, no init)",
+            threaded,
+            per_chip_ops,
+        )
+
     if not want("latency"):
         return 0
 

@@ -337,3 +337,198 @@ def test_multi_group_overflow_falls_back_to_scan():
         engine2 = uni2.apply_changes_with_patches({"observer": stream2})["observer"]
     assert uni2.stats.get("multi_group_fallbacks", 0) == 0
     assert engine2 == oracle2_patches
+
+
+def test_winner_cache_persists_across_patched_ingests():
+    """The patched merge threads its per-slot per-type winner cache between
+    ingests (the dominance init runs once, not per merge).  The cache is
+    DERIVED state: after any ingest sequence it must equal a fresh init
+    over the current boundary rows, streams must stay oracle-identical,
+    and every invalidation path (non-patched merge, capacity growth) must
+    recover."""
+    import jax
+    import numpy as np
+
+    from peritext_tpu.ops import kernels as K
+    from peritext_tpu.schema import allow_multiple_array
+    from peritext_tpu.testing import patch_path_env
+
+    docs, _, genesis = generate_docs("Hello collaborative world", 2)
+    a, b = docs
+    oracle = Doc("obs2")
+
+    def assert_cache_is_derived(uni):
+        st = uni.states
+        multi = jax.numpy.asarray(allow_multiple_array())
+        ranks = jax.numpy.asarray(uni._ranks())
+        fresh = K._winner_cache_init(
+            st.bnd_mask[0],
+            (
+                st.mark_ctr[0],
+                st.mark_act[0],
+                st.mark_action[0],
+                st.mark_type[0],
+                st.mark_attr[0],
+            ),
+            ranks,
+            multi.shape[0],
+            uni.max_mark_ops,
+            multi,
+        )
+        got, want = np.asarray(uni._wcaches[0]), np.asarray(fresh)
+        defined = np.asarray(st.bnd_def[0])
+        assert (got[defined] == want[defined]).all()
+
+    with patch_path_env(None):
+        uni = TpuUniverse(["obs"], capacity=64)
+
+        def step(changes):
+            p = uni.apply_changes_with_patches({"obs": changes})["obs"]
+            po = list(oracle.apply_change(changes[0])) if len(changes) == 1 else None
+            if po is not None:
+                assert p == po
+            return p
+
+        step([genesis])
+        mk, _ = a.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": 0,
+              "endIndex": 5, "markType": "strong"}]
+        )
+        b.apply_change(mk)
+        step([mk])  # init path
+        assert uni._wcaches is not None
+        assert_cache_is_derived(uni)
+
+        # Author by the ALREADY-interned actor: a change from a new actor
+        # renumbers ranks and (correctly) invalidates instead
+        # (test_winner_cache_invalidated_by_actor_interning covers that).
+        ins, _ = a.change(
+            [{"path": ["text"], "action": "insert", "index": 3, "values": list("xyz")}]
+        )
+        b.apply_change(ins)
+        step([ins])  # no-marks passthrough keeps the cache (permuted)
+        assert uni._wcaches is not None
+        assert_cache_is_derived(uni)
+
+        mk2, _ = a.change(
+            [
+                {"path": ["text"], "action": "addMark", "startIndex": 2,
+                 "endIndex": 10, "markType": "em"},
+                {"path": ["text"], "action": "removeMark", "startIndex": 0,
+                 "endIndex": 4, "markType": "strong"},
+            ]
+        )
+        b.apply_change(mk2)
+        step([mk2])  # threaded-cache path (no init)
+        assert_cache_is_derived(uni)
+
+        # Non-patched ingest invalidates...
+        mk3, _ = b.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": 1,
+              "endIndex": 6, "markType": "comment", "attrs": {"id": "w1"}}]
+        )
+        a.apply_change(mk3)
+        oracle.apply_change(mk3)
+        uni.apply_changes({"obs": [mk3]})
+        assert uni._wcaches is None
+        # ...and the next patched ingest re-inits and stays correct.
+        mk4, _ = a.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": 0,
+              "endIndex": 8, "markType": "strong"}]
+        )
+        b.apply_change(mk4)
+        step([mk4])
+        assert uni._wcaches is not None
+        assert_cache_is_derived(uni)
+
+        # Capacity growth invalidates (shape change), then recovers.
+        big, _ = a.change(
+            [{"path": ["text"], "action": "insert", "index": 0,
+              "values": list("x" * 80)}]
+        )
+        b.apply_change(big)
+        step([big])
+        assert uni.capacity > 64
+        mk5, _ = b.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": 10,
+              "endIndex": 40, "markType": "em"}]
+        )
+        a.apply_change(mk5)
+        step([mk5])
+        assert_cache_is_derived(uni)
+        assert uni.spans("obs") == oracle.get_text_with_formatting(["text"]) == \
+            a.get_text_with_formatting(["text"])
+
+
+def test_winner_cache_invalidated_by_actor_interning():
+    """Interning a NEW actor renumbers every actor rank (lexicographic,
+    ids.py); the persisted winner cache stores rank VALUES, so it must not
+    survive a registry change — the derived-state invariant (cache == a
+    fresh init under CURRENT ranks) has to hold after a change from a
+    previously unseen actor arrives."""
+    import jax
+    import numpy as np
+
+    from peritext_tpu.ops import kernels as K
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.schema import allow_multiple_array
+    from peritext_tpu.testing import patch_path_env
+
+    # 'm' and 'z' first; 'a' interned later sorts BEFORE both, shifting
+    # every rank.
+    m, z, a = Doc("m"), Doc("z"), Doc("a")
+    genesis, _ = m.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list("rank shift")},
+        ]
+    )
+    for d in (z, a):
+        d.apply_change(genesis)
+
+    with patch_path_env(None):
+        uni = TpuUniverse(["obs"], capacity=64)
+        oracle = Doc("obs2")
+
+        def step(change):
+            p = uni.apply_changes_with_patches({"obs": [change]})["obs"]
+            assert p == list(oracle.apply_change(change))
+
+        step(genesis)
+        c1, _ = z.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": 0,
+              "endIndex": 4, "markType": "strong"}]
+        )
+        for d in (m, a):
+            d.apply_change(c1)
+        step(c1)
+        assert uni._wcaches is not None
+        actors_before = uni._wcaches_actors
+
+        # New actor 'a' authors a mark: interned during _prepare, ranks
+        # renumber, the stale cache must be rebuilt (not threaded).
+        c2, _ = a.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": 2,
+              "endIndex": 8, "markType": "em"}]
+        )
+        for d in (m, z):
+            d.apply_change(c2)
+        step(c2)
+        assert uni._wcaches_actors > actors_before
+
+        st = uni.states
+        multi = jax.numpy.asarray(allow_multiple_array())
+        ranks = jax.numpy.asarray(uni._ranks())
+        fresh = K._winner_cache_init(
+            st.bnd_mask[0],
+            (st.mark_ctr[0], st.mark_act[0], st.mark_action[0],
+             st.mark_type[0], st.mark_attr[0]),
+            ranks, multi.shape[0], uni.max_mark_ops, multi,
+        )
+        got, want = np.asarray(uni._wcaches[0]), np.asarray(fresh)
+        defined = np.asarray(st.bnd_def[0])
+        assert (got[defined] == want[defined]).all(), (
+            "cache kept stale actor ranks across interning"
+        )
+        assert uni.spans("obs") == oracle.get_text_with_formatting(["text"])
