@@ -240,3 +240,27 @@ def test_snapshot_restores_registered_mark_types(tmp_path):
         # reruns of this one — there is deliberately no public unregister).
         schema.MARK_SPEC.pop("ckpt_only_mark", None)
         schema._rebuild_views()
+
+
+def test_snapshot_rebuilds_multi_group_census(tmp_path):
+    """The allowMultiple group census (gates the cached patch scan) is
+    derived from the mark tables; load_universe must rebuild it equal to
+    the live universe's census."""
+    docs, log, uni = build_session(tmp_path)
+    c, _ = docs[0].change(
+        [
+            {"path": ["text"], "action": "addMark", "startIndex": 0,
+             "endIndex": 6, "markType": "comment", "attrs": {"id": "cen-1"}},
+            {"path": ["text"], "action": "addMark", "startIndex": 3,
+             "endIndex": 9, "markType": "comment", "attrs": {"id": "cen-2"}},
+            {"path": ["text"], "action": "removeMark", "startIndex": 0,
+             "endIndex": 4, "markType": "comment", "attrs": {"id": "cen-1"}},
+        ]
+    )
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni._multi_groups
+
+    path = os.path.join(tmp_path, "snap")
+    save_universe(uni, path)
+    restored = load_universe(path)
+    assert restored._multi_groups == uni._multi_groups
