@@ -328,6 +328,12 @@ class TpuDoc:
         uni._ensure_capacity(uni.lengths[0], uni.mark_counts[0])
 
         op_rows = np.stack(rows)
+        # Locally applied mark rows occupy table columns exactly like
+        # ingested ones, so they must count toward the allowMultiple group
+        # census (mirrors _commit) — otherwise a later remote ingest on a
+        # locally-overgrown group passes the cached-scan overflow gate and
+        # _group_topk_cols drops carry-bearing columns from its patches.
+        uni._count_multi_groups(op_rows)
         state = self._state()
         new_state, records = K.apply_ops_patched_jit(
             state,

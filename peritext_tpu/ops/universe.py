@@ -287,12 +287,25 @@ def fold_multi_groups(
     census[(type_id, attr_id)] accumulates distinct (ctr, act_id) op
     identities.  THE one definition of group identity — the live ingest
     census, the pre-launch overflow gate, and the checkpoint rebuild all
-    fold through here, so they can never disagree."""
+    fold through here, so they can never disagree.
+
+    Each group's set is capped at PATCH_GROUP_K + 1 identities: the gate
+    only asks "over cap?", so a set already past the cap never needs more
+    members, and a long-lived universe's census stays O(groups * K) instead
+    of growing with every allowMultiple op ever ingested.  The cap keeps
+    the K+1 *smallest* identities, so the retained subset is a pure
+    function of the identities seen — fold order (live ingest vs the
+    checkpoint rebuild's per-replica table scan) cannot make two censuses
+    disagree."""
     multi_by_id = schema.ALLOW_MULTIPLE_BY_ID
+    cap = K.PATCH_GROUP_K + 1
     for t, attr, ctr, act in zip(types, attr_ids, ctrs, act_ids):
         t = int(t)
         if t < len(multi_by_id) and multi_by_id[t]:
-            census.setdefault((t, int(attr)), set()).add((int(ctr), int(act)))
+            ops = census.setdefault((t, int(attr)), set())
+            ops.add((int(ctr), int(act)))
+            if len(ops) > cap:
+                ops.discard(max(ops))
 
 
 def fold_multi_group_rows(census: Dict[Tuple[int, int], set], rows) -> None:

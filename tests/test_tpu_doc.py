@@ -206,3 +206,76 @@ def test_fuzz_mixed_engines_nested_objects():
         return next(engines)(actor_id)
 
     fuzz(iterations=40, seed=9, doc_factory=factory, nested=True)
+
+
+def test_local_marks_count_toward_multi_group_gate():
+    """Locally generated allowMultiple ops occupy mark-table columns just
+    like ingested ones, so TpuDoc.change() must fold them into the group
+    census.  Regression: K+1 local ops on ONE comment id, then a remote
+    ingest on the same id — the cached-scan overflow gate must fire (the
+    compacted top-K column window can no longer hold the group) and the
+    emitted patches must stay byte-identical to the oracle's."""
+    from peritext_tpu.ops import kernels as K
+    from peritext_tpu.testing import patch_path_env
+
+    with patch_path_env(None):
+        oracle_src = Doc("src")
+        genesis, _ = oracle_src.change(
+            [
+                {"path": [], "action": "makeList", "key": "text"},
+                {
+                    "path": ["text"],
+                    "action": "insert",
+                    "index": 0,
+                    "values": list("commented text here"),
+                },
+            ]
+        )
+        tpu = TpuDoc("tpu")
+        tpu.apply_change(genesis)
+        remote = Doc("remote")
+        remote.apply_change(genesis)
+        observer = Doc("observer")
+        observer.apply_change(genesis)
+
+        # K+1 distinct LOCAL ops in the (comment, 'hot') group.
+        for i in range(K.PATCH_GROUP_K + 1):
+            action = "addMark" if i % 2 == 0 else "removeMark"
+            change, _ = tpu.change(
+                [
+                    {
+                        "path": ["text"],
+                        "action": action,
+                        "startIndex": i % 5,
+                        "endIndex": 6 + (i % 4),
+                        "markType": "comment",
+                        "attrs": {"id": "hot"},
+                    }
+                ]
+            )
+            remote.apply_change(change)
+            observer.apply_change(change)
+
+        # One remote op on the overgrown group: alone it is far under the
+        # cap, so only the census (fed by the local path) can trip the gate.
+        remote_change, _ = remote.change(
+            [
+                {
+                    "path": ["text"],
+                    "action": "addMark",
+                    "startIndex": 2,
+                    "endIndex": 9,
+                    "markType": "comment",
+                    "attrs": {"id": "hot"},
+                }
+            ]
+        )
+        expected = observer.apply_change(remote_change)
+        got = tpu.apply_change(remote_change)
+        assert tpu._uni.stats.get("multi_group_fallbacks", 0) > 0, (
+            "overflow gate never fired: local mark rows missing from census"
+        )
+        assert got == expected
+        assert tpu.get_text_with_formatting(
+            ["text"]
+        ) == observer.get_text_with_formatting(["text"])
