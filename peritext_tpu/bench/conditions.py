@@ -1,0 +1,67 @@
+"""Measurement-conditions stamp for perf artifacts (VERDICT r4 weak #4).
+
+On a one-core box every latency number is load-dependent: the same code
+measured 6.6-19.8 ms p50 across round-4 artifacts depending on what else
+was running.  The perf emitters (bench.py's headline line via run_bench,
+the BASELINE configs CLI, scripts/stream_ab.py, SELFBENCH records) embed
+this stamp so round-over-round comparisons can be read honestly.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Any, Dict
+
+
+def measurement_conditions(platform: str | None = None) -> Dict[str, Any]:
+    """One JSON-able dict: platform, commit, load average, competing
+    processes, CPU count, wall time.  Cheap enough to call per artifact."""
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        ).stdout.strip() or None
+    except Exception:
+        commit = None
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = -1.0
+    # Competing compute: any R-state python/pytest besides ourselves is a
+    # soak or bench stealing the core (nice 19 still steals ~35% here).
+    # The comm filter also excludes the momentary `ps` child below, so an
+    # idle box reads 0.
+    competitors = 0
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,stat,comm"], capture_output=True, text=True, timeout=10
+        ).stdout
+        me = os.getpid()
+        for line in out.splitlines()[1:]:
+            parts = line.split(None, 2)
+            if (
+                len(parts) == 3
+                and parts[1].startswith("R")
+                and int(parts[0]) != me
+                and ("python" in parts[2] or "pytest" in parts[2])
+            ):
+                competitors += 1
+    except Exception:
+        competitors = -1
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "unknown"
+    return {
+        "platform": platform,
+        "commit": commit,
+        "load_avg": [round(load1, 2), round(load5, 2), round(load15, 2)],
+        "competing_running_procs": competitors,
+        "cpu_count": os.cpu_count(),
+        "unix_time": int(time.time()),
+    }

@@ -168,13 +168,26 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
     while capacity < doc_len + 512:
         capacity *= 2
 
+    # CONFIG5_STREAM_COHORT=N runs the streaming-cohort route (the
+    # north-star path past the HBM residency wall, parallel/stream.py):
+    # the population lives host-side and cohorts of N replicas stream
+    # through the mesh, double-buffered.  Warmup compiles the one
+    # cohort-shaped program on a single cohort; the measured pass streams
+    # the full population with fresh op ids.
+    stream_cohort = int(os.environ.get("CONFIG5_STREAM_COHORT", "0"))
+
     n_streams = 4
     workload = make_merge_workload(doc_len=doc_len, ops_per_merge=64,
                                    num_streams=n_streams, with_marks=True, seed=5)
-    batch = build_device_batch(workload, replicas, capacity, 128)
+    # In streaming mode the device only ever sees one cohort: build the
+    # base state + the n_streams distinct op streams at n_streams rows and
+    # tile HOST-side — a beyond-residency population must never be
+    # materialized device-resident, which is the route's whole point.
+    batch = build_device_batch(
+        workload, n_streams if stream_cohort else replicas, capacity, 128
+    )
     seq = 2 if n_dev % 2 == 0 and n_dev >= 4 else 1
     mesh = make_mesh(jax.devices()[: (n_dev // seq) * seq], n_dev // seq, seq)
-    base_states = shard_states(batch["states"], mesh)
 
     # Host prep runs once per distinct stream; one gather tiles it to R
     # (the same trick as TpuUniverse._prepare — never per-replica Python).
@@ -183,6 +196,106 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
     text_np = sp["text"][tile]
     rounds_np = sp["rounds"][tile]
     bufs_np = sp["bufs"][tile]
+
+    if stream_cohort:
+        from peritext_tpu.bench.conditions import measurement_conditions
+        from peritext_tpu.bench.workloads import shift_op_ids
+        from peritext_tpu.parallel.stream import stream_merge_sorted
+
+        genesis_max = workload["genesis"]["startOp"] + len(workload["genesis"]["ops"]) - 1
+        # Every replica starts from the same base state: the host
+        # population is a zero-copy broadcast view of row 0 (the stream
+        # executor copies per cohort at device_put time).
+        states_np = jax.tree.map(
+            lambda a: np.broadcast_to(np.asarray(a[:1]), (replicas,) + a.shape[1:]),
+            batch["states"],
+        )
+        batch["states"] = None  # free the device-resident copy
+        marks_np = batch["mark_ops"][tile]
+        # Per-replica op counts for the tiled population (rows are
+        # zero-padded; K_KIND=0 is inert padding).
+        per_stream = np.asarray(
+            [
+                (batch["text_ops"][s][:, K.K_KIND] != 0).sum()
+                + (batch["mark_ops"][s][:, K.K_KIND] != 0).sum()
+                for s in range(n_streams)
+            ]
+        )
+        stream_total_ops = int(per_stream[tile].sum())
+
+        def stream(shift, rows):
+            return stream_merge_sorted(
+                jax.tree.map(lambda a: a[:rows], states_np),
+                shift_op_ids(text_np[:rows], shift, genesis_max),
+                rounds_np[:rows],
+                sp["num_rounds"],
+                shift_op_ids(marks_np[:rows], shift, genesis_max),
+                batch["ranks"],
+                bufs_np[:rows],
+                sp["maxk"],
+                cohort=stream_cohort,
+                mesh=mesh,
+            )
+
+        stream(1_000_000, min(stream_cohort, replicas))  # compile on one cohort
+        start = time.perf_counter()
+        out_states, digests, stats = stream(2_000_000, replicas)
+        merge_s = time.perf_counter() - start
+        for r in range(n_streams, replicas):
+            assert digests[r] == digests[r % n_streams], "config5 stream diverged"
+
+        # Flatten one resident cohort of the streamed output (the flatten
+        # leg of a streaming pass is per-cohort by construction).  The
+        # effective cohort (stats) is already a replica-axis multiple; clamp
+        # to the population by padding with row 0, mirroring the stream's
+        # own tail handling, so shard_states always divides evenly.
+        rows = min(stats["cohort"], replicas)
+        pad_to = -(-rows // int(mesh.shape["replica"])) * int(mesh.shape["replica"])
+
+        def cohort_rows(a):
+            sl = np.asarray(a[:rows])
+            if pad_to > rows:
+                fill = np.broadcast_to(sl[0:1], (pad_to - rows,) + sl.shape[1:])
+                sl = np.concatenate([sl, fill], axis=0)
+            return jnp.asarray(sl)
+
+        rows = pad_to
+        cohort_states = shard_states(jax.tree.map(cohort_rows, out_states), mesh)
+        flatten = flatten_sources_sp(mesh)
+
+        def flatten_cohort():
+            mask, has = flatten(
+                cohort_states.deleted,
+                cohort_states.bnd_def,
+                cohort_states.bnd_mask,
+                cohort_states.length,
+            )
+            np.asarray(has)
+
+        flatten_cohort()  # compile
+        start = time.perf_counter()
+        flatten_cohort()
+        flatten_s = time.perf_counter() - start
+
+        total_ops = stream_total_ops
+        return {
+            "config": 5,
+            "merge": "streaming_cohorts",
+            "workload": f"{replicas} replicas x {doc_len}-char docs, mixed marks, "
+            f"streamed in {stats['n_cohorts']} cohorts of {stats['cohort']} "
+            f"over mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}",
+            "merge_ops_per_sec": round(total_ops / merge_s, 1),
+            "merge_seconds": round(merge_s, 4),
+            "cohort": stats["cohort"],
+            "n_cohorts": stats["n_cohorts"],
+            "flatten_chars_per_sec_per_cohort": round(rows * doc_len / flatten_s, 1),
+            "platform": jax.devices()[0].platform,
+            "conditions": measurement_conditions(),
+            "note": "streaming-cohort route: aggregate replicas decoupled from "
+            "device residency (BASELINE.md north-star route)",
+        }
+
+    base_states = shard_states(batch["states"], mesh)
     ranks = jnp.asarray(batch["ranks"])
     multi = jnp.asarray(allow_multiple_array())
 
@@ -323,7 +436,12 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-    print(json.dumps(CONFIGS[args.config]()))
+    record = CONFIGS[args.config]()
+    if "conditions" not in record:
+        from peritext_tpu.bench.conditions import measurement_conditions
+
+        record["conditions"] = measurement_conditions()
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

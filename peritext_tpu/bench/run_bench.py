@@ -63,6 +63,8 @@ def main() -> None:
 
     import jax
 
+    from peritext_tpu.bench.conditions import measurement_conditions
+
     result = {
         "metric": "merged_crdt_ops_per_sec_batched_replicas",
         "value": round(tpu["ops_per_sec"], 1),
@@ -70,6 +72,7 @@ def main() -> None:
         "vs_baseline": round(tpu["ops_per_sec"] / scalar["ops_per_sec"], 2),
         "platform": jax.devices()[0].platform,
         "path": path,
+        "conditions": measurement_conditions(platform=jax.devices()[0].platform),
     }
     # Salvage point: the headline throughput is safe on stdout NOW; if the
     # relay wedges during the latency measurement below, the supervisor

@@ -1,0 +1,230 @@
+"""Streaming replica cohorts: aggregate replica count decoupled from HBM.
+
+The north-star shape (BASELINE.json config 5: 100k replicas x 10k-char
+docs) does not fit resident on a v5e-8 — the HBM budget table in
+BASELINE.md caps residency at ~27.7k replicas at C=16384/M=1024.  This
+module is the route past that wall: the full replica population lives in
+host memory as numpy arrays, and fixed-size *cohorts* stream through the
+device mesh.  Each cohort is device_put (async H2D DMA), merged with a
+buffer-donating jitted step, digested on device, and read back — with the
+next cohort's transfer and dispatch issued *before* the previous cohort's
+readback, so on real hardware the H2D/D2H DMAs overlap the merge compute
+(JAX dispatch is asynchronous; the readback `np.asarray` is the only
+barrier, which on the axon relay is also the only honest one).
+
+Device residency is bounded by the pipeline depth (two cohorts in flight)
+times the cohort footprint, independent of the aggregate replica count:
+
+    resident bytes ~= depth * cohort * (state_bytes + transient_bytes)
+
+where the merge transients are O(L*C + M*2C) per replica (kernels.py,
+merge_step_sorted_batch docstring).  `cohort_for_budget` sizes a cohort
+from that estimate; `stream_merge_sorted` is bit-identical to the resident
+single-launch merge (tests/test_stream.py digest- and state-compares the
+two at shapes that fit both ways).
+
+This is the multi-replica generalization of the PERITEXT_SORTED_CHUNK
+valve: the valve re-launches over slices of a *device-resident* batch to
+bound transients; the stream bounds *state* residency too, holding the
+population on host.  The reference has no counterpart — its replicas are
+one JS heap each (micromerge.ts holds a single document); population
+scale-out is exactly what the TPU redesign adds.
+"""
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.state import DocState, make_empty_state
+from peritext_tpu.parallel.mesh import state_sharding
+from peritext_tpu.schema import allow_multiple_array
+
+
+def state_bytes_per_replica(capacity: int, max_mark_ops: int) -> int:
+    """Exact DocState bytes for one replica at the given shape."""
+    proto = make_empty_state(capacity, max_mark_ops)
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(proto))
+
+
+def transient_bytes_per_replica(capacity: int, max_mark_ops: int, ops_len: int) -> int:
+    """Upper-bound merge transients per replica: the placement phase holds
+    O(L*C) int32 compare planes and the mark phase O(L_mark*2C) planes,
+    where L is the op-batch length (a batch's mark rows are bounded by its
+    op count, NOT the table capacity M).  ~3 L*C-sized int32 planes total —
+    within 2x of the compiler-measured temp HBM at the bench shape
+    (PROFILE_r04.md: 653 MiB / 256 replicas = 2.55 MiB at C=2048, L=64;
+    this returns 1.5 MiB, scaled by the 2x safety factor below)."""
+    del max_mark_ops  # table capacity does not size the transients
+    return 2 * 4 * (ops_len * capacity + ops_len * 2 * capacity)
+
+
+def cohort_for_budget(
+    capacity: int,
+    max_mark_ops: int,
+    ops_len: int,
+    hbm_bytes: int = 16 * 2**30,
+    headroom: float = 0.9,
+    depth: int = 2,
+    n_devices: int = 1,
+) -> int:
+    """Largest cohort whose ``depth`` in-flight copies fit the HBM budget
+    (per device; a mesh multiplies the budget by its device count)."""
+    per = state_bytes_per_replica(capacity, max_mark_ops) + transient_bytes_per_replica(
+        capacity, max_mark_ops, ops_len
+    )
+    return max(1, int(hbm_bytes * headroom * n_devices / (depth * per)))
+
+
+@functools.lru_cache(maxsize=None)
+def _stream_step(maxk: int, compute_digest: bool):
+    """Jitted cohort step: sorted merge + optional digest, donating the
+    input state buffers so device residency stays at one cohort copy per
+    pipeline slot (the resident-path jit must NOT donate — benches and the
+    universe reuse their input states across launches)."""
+    merge = jax.vmap(
+        functools.partial(K.merge_step_sorted, maxk=maxk),
+        in_axes=(0, 0, 0, None, 0, None, 0),
+    )
+
+    def step(states, text_ops, round_of, num_rounds, mark_ops, ranks, char_buf, multi):
+        out = merge(states, text_ops, round_of, num_rounds, mark_ops, ranks, char_buf)
+        if compute_digest:
+            dg = jax.vmap(K.convergence_digest, in_axes=(0, None, None))(
+                out, ranks, multi
+            )
+        else:
+            # Not a digest — a completion token: it must DEPEND on the merge
+            # output so the drain-side readback is a real barrier even when
+            # the caller skips both digests and state readback.
+            dg = out.length.astype(jnp.uint32)
+        return out, dg
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def _cohort_shardings(mesh: Optional[Mesh], shard_seq: bool):
+    """(state sharding, [R,...] op sharding) for device_put, or Nones."""
+    if mesh is None:
+        return None, None
+    return state_sharding(mesh, shard_seq), NamedSharding(mesh, P("replica"))
+
+
+def stream_merge_sorted(
+    states: DocState,
+    text_ops: np.ndarray,
+    round_of: np.ndarray,
+    num_rounds: int,
+    mark_ops: np.ndarray,
+    ranks: np.ndarray,
+    char_buf: np.ndarray,
+    maxk: int,
+    cohort: int,
+    mesh: Optional[Mesh] = None,
+    shard_seq: bool = True,
+    compute_digest: bool = True,
+    readback_states: bool = True,
+    depth: int = 2,
+) -> Tuple[Optional[DocState], np.ndarray, Dict[str, Any]]:
+    """Merge an arbitrarily large replica population by streaming cohorts.
+
+    ``states`` and the op tensors are host-resident (numpy) with leading
+    dim R_total; at most ``depth`` cohorts of ``cohort`` replicas are on
+    device at once.  Returns (updated host states | None, digests [R_total],
+    stats).  The tail cohort is padded by repeating replica 0 (one compiled
+    program shape); padded lanes are dropped on readback.  With
+    ``compute_digest=False`` the digest slot carries post-merge lengths
+    instead (a cheap completion token, not a convergence digest).
+    """
+    r_total = int(text_ops.shape[0])
+    if cohort <= 0:
+        raise ValueError(f"cohort must be positive, got {cohort}")
+    if mesh is None:
+        cohort = min(cohort, r_total)
+    else:
+        # Cohorts must divide over the replica mesh axis; round up (the tail
+        # pad already fills partial cohorts, so over-sizing is harmless and
+        # keeps one compiled program shape).
+        axis = int(mesh.shape["replica"])
+        cohort = min(cohort, -(-r_total // axis) * axis)
+        cohort = -(-cohort // axis) * axis
+    state_shd, ops_shd = _cohort_shardings(mesh, shard_seq)
+    step = _stream_step(maxk, compute_digest)
+    nr = jnp.int32(num_rounds)
+    ranks_d = jax.device_put(
+        jnp.asarray(ranks), NamedSharding(mesh, P()) if mesh is not None else None
+    )
+    multi_d = jax.device_put(
+        jnp.asarray(allow_multiple_array()),
+        NamedSharding(mesh, P()) if mesh is not None else None,
+    )
+
+    host_states = jax.tree.map(np.asarray, states)
+    out_states = (
+        jax.tree.map(np.empty_like, host_states) if readback_states else None
+    )
+    digests = np.zeros((r_total,), np.uint32)
+
+    def pad(arr, lo, hi):
+        """Cohort slice [lo:hi), padded to `cohort` rows with replica 0."""
+        sl = arr[lo:hi]
+        if hi - lo == cohort:
+            return sl
+        fill = np.broadcast_to(arr[0:1], (cohort - (hi - lo),) + arr.shape[1:])
+        return np.concatenate([sl, fill], axis=0)
+
+    def launch(lo: int):
+        hi = min(lo + cohort, r_total)
+        st = jax.tree.map(lambda a: pad(a, lo, hi), host_states)
+        st_d = (
+            jax.tree.map(jax.device_put, st, state_shd)
+            if state_shd is not None
+            else jax.tree.map(jax.device_put, st)
+        )
+        puts = [
+            jax.device_put(pad(a, lo, hi), ops_shd)
+            for a in (text_ops, round_of, mark_ops, char_buf)
+        ]
+        out, dg = step(st_d, puts[0], puts[1], nr, puts[2], ranks_d, puts[3], multi_d)
+        return lo, hi, out, dg
+
+    def drain(entry):
+        lo, hi, out, dg = entry
+        n = hi - lo
+        digests[lo:hi] = np.asarray(dg)[:n]
+        if out_states is not None:
+            for host_leaf, dev_leaf in zip(
+                jax.tree.leaves(out_states), jax.tree.leaves(out)
+            ):
+                host_leaf[lo:hi] = np.asarray(dev_leaf)[:n]
+        else:
+            # Digest readback above is the completion barrier already.
+            del out
+
+    inflight: deque = deque()
+    n_cohorts = 0
+    for lo in range(0, r_total, cohort):
+        inflight.append(launch(lo))
+        n_cohorts += 1
+        # Keep `depth` cohorts in flight: the next cohort's H2D and merge
+        # are dispatched (async) before this readback blocks, so the DMA
+        # engines overlap the compute on hardware.
+        while len(inflight) >= depth:
+            drain(inflight.popleft())
+    while inflight:
+        drain(inflight.popleft())
+
+    stats = {
+        "replicas": r_total,
+        "cohort": cohort,
+        "n_cohorts": n_cohorts,
+        "depth": depth,
+        "padded_tail": (r_total % cohort) != 0,
+    }
+    return out_states, digests, stats

@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""Sum materialized op-output bytes in an optimized HLO dump.
+
+The honest HBM-traffic floor for a compiled program (PROFILE_r04.md): XLA's
+`cost_analysis()['bytes accessed']` double-counts operands at fusion
+boundaries (3-10x inflation), so instead we sum the OUTPUT sizes of the
+instructions that actually materialize buffers — every instruction in a
+non-fusion computation except the free ones (parameters, tuples,
+get-tuple-element, bitcasts, and the while/conditional wrappers whose
+outputs alias their bodies').  Real traffic is bounded below by one write
+per materialized output (and usually ~2x that, for the reads).
+
+While-loop bodies are counted ONCE (one trip); for the merge kernels the
+honest score therefore uses the static-rounds roofline variant (the loop
+body IS the per-launch work at num_rounds=1, the bench regime), and any
+multi-trip shape must be scaled by the caller.
+
+Usage:
+    python scripts/hlo_bytes.py /tmp/hlo_*.txt
+    python scripts/hlo_bytes.py --per-op dump.txt   # top contributors
+"""
+from __future__ import annotations
+
+import json
+import re
+import sys
+from collections import defaultdict
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    # Sub-byte int4 rounds up to a byte (conservative); fp8 variants are 1.
+    "s4": 1, "u4": 1, "s2": 1, "u2": 1, "f8": 1,
+}
+
+# Instruction outputs that do not materialize a new HBM buffer.
+_FREE_OPS = {
+    "parameter", "tuple", "get-tuple-element", "bitcast", "constant",
+    "while", "conditional", "call", "after-all", "partition-id",
+    "replica-id", "custom-call",  # custom-calls here are only annotations
+}
+
+_SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\(?)((?:pred|[suf]\d+|bf16)\[[^=]*?)\s+"
+    r"([\w\-]+)\(",
+)
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\([^)]*\)\s*->")
+
+
+def shape_bytes(shapes_text: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shapes_text):
+        size = _DTYPE_BYTES.get(dt)
+        if size is None:  # unknown dtype token: skip rather than die
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def parse(path: str):
+    """Per-computation, per-opcode materialized output bytes."""
+    comps: dict[str, dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    current = None
+    with open(path) as f:
+        for line in f:
+            m = _COMP_RE.match(line)
+            if m:
+                current = m.group(1)
+                continue
+            if current is None:
+                continue
+            m = _INSTR_RE.match(line)
+            if not m:
+                continue
+            _, _, shapes, opcode = m.groups()
+            if opcode in _FREE_OPS:
+                continue
+            comps[current][opcode] += shape_bytes(shapes)
+    return comps
+
+
+def score(path: str, per_op: bool = False) -> dict:
+    comps = parse(path)
+    # Fusion sub-computations don't materialize (their fusion instruction,
+    # counted in the parent, does).
+    real = {
+        name: ops
+        for name, ops in comps.items()
+        if not name.startswith(("fused_computation", "region"))
+    }
+    total = sum(sum(ops.values()) for ops in real.values())
+    out = {
+        "path": path,
+        "output_sum_bytes": total,
+        "output_sum_gib": round(total / 2**30, 3),
+        "computations": {
+            name: round(sum(ops.values()) / 2**20, 1) for name, ops in real.items()
+        },
+    }
+    if per_op:
+        flat: dict[str, int] = defaultdict(int)
+        for ops in real.values():
+            for op, b in ops.items():
+                flat[op] += b
+        out["by_opcode_mib"] = {
+            op: round(b / 2**20, 1)
+            for op, b in sorted(flat.items(), key=lambda kv: -kv[1])
+        }
+    return out
+
+
+def main() -> None:
+    per_op = "--per-op" in sys.argv
+    paths = [a for a in sys.argv[1:] if not a.startswith("--")]
+    for p in paths:
+        print(json.dumps(score(p, per_op)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""North-star route measurements (VERDICT r4 item 1), relay-free.
+
+Two A/Bs, written as one JSON line each to artifacts/stream_ab_r05.jsonl:
+
+1. streaming-cohort overhead: resident vs streamed merge at a shape that
+   fits both ways (ops/s each way + the overhead ratio) — the per-pass
+   cost a beyond-residency population pays on the streaming route.
+2. W=8 mark-budget route: the config-4 shape at forced mark-table
+   capacity M=1024 (W=32) vs M=256 (W=8) — the throughput effect of the
+   4x-smaller boundary bitset that buys ~3.2x replica residency
+   (BASELINE.md budget table).
+
+Usage: python scripts/stream_ab.py [--quick]  (quick: small shapes, CI)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Same convention as configs.py --platform: "ambient" means don't pin (use
+# whatever the environment provides, e.g. the relayed TPU); anything else
+# is pinned BEFORE first backend use (sitecustomize pins axon,cpu — env
+# vars alone do not override, and a wedged relay hangs the first device op).
+_platform = os.environ.get("STREAM_AB_PLATFORM", "cpu")
+if _platform != "ambient":
+    jax.config.update("jax_platforms", _platform)
+
+from peritext_tpu.bench.conditions import measurement_conditions
+from peritext_tpu.bench.workloads import time_batched_merge, time_streaming_ab
+from peritext_tpu.parallel.stream import state_bytes_per_replica
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--quick", action="store_true", help="small shapes (CI smoke)")
+    parser.add_argument(
+        "--out", default="artifacts/stream_ab_r05.jsonl", help="output JSONL path"
+    )
+    args = parser.parse_args()
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    records = []
+
+    # -- 1. streaming overhead at a fits-both-ways shape -------------------
+    shape = (
+        dict(num_replicas=64, doc_len=200, ops_per_merge=24, cohort=16)
+        if args.quick
+        else dict(num_replicas=2048, doc_len=1000, ops_per_merge=64, cohort=512)
+    )
+    r = time_streaming_ab(**shape)
+    records.append(
+        {
+            "ab": "streaming_overhead",
+            **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in r.items()},
+            "conditions": measurement_conditions(),
+        }
+    )
+
+    # -- 2. W=8 route at the config-4 shape --------------------------------
+    # rounds=2 keeps the live mark table under the forced M=256 budget
+    # (the run asserts it); both legs run the identical workload.
+    c4 = (
+        dict(num_replicas=64, doc_len=200, ops_per_merge=24, rounds=2)
+        if args.quick
+        else dict(num_replicas=10240, doc_len=1000, ops_per_merge=64, rounds=2)
+    )
+    legs = {}
+    for label, budget in (("w32_m1024", 1024), ("w8_m256", 256)):
+        out = time_batched_merge(**c4, with_marks=True, mark_budget=budget)
+        legs[label] = {
+            "ops_per_sec": round(out["ops_per_sec"], 1),
+            "seconds": round(out["seconds"], 4),
+            "max_marks": out["max_marks"],
+            "state_bytes_per_replica": state_bytes_per_replica(
+                out["capacity"], out["max_marks"]
+            ),
+        }
+    records.append(
+        {
+            "ab": "w8_mark_budget",
+            "shape": c4,
+            **legs,
+            "w8_speedup": round(
+                legs["w8_m256"]["ops_per_sec"] / legs["w32_m1024"]["ops_per_sec"], 3
+            ),
+            "residency_gain": round(
+                legs["w32_m1024"]["state_bytes_per_replica"]
+                / legs["w8_m256"]["state_bytes_per_replica"],
+                3,
+            ),
+            "conditions": measurement_conditions(),
+        }
+    )
+
+    with open(args.out, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+            print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

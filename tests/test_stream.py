@@ -1,0 +1,148 @@
+"""Streaming replica cohorts (parallel/stream.py): the north-star route.
+
+The contract under test: streaming an R-replica population through the
+device in cohorts — any cohort size, padded tails, meshes, pipeline depths
+— produces bit-identical states and digests to the resident single-launch
+sorted merge.  That equivalence is what lets the HBM budget table's
+residency wall (BASELINE.md) be crossed without a semantics risk.
+"""
+import jax
+import numpy as np
+import pytest
+
+from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
+from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops.encode import prepare_sorted_batch
+from peritext_tpu.parallel import make_mesh
+from peritext_tpu.parallel.stream import (
+    cohort_for_budget,
+    state_bytes_per_replica,
+    stream_merge_sorted,
+)
+from peritext_tpu.schema import allow_multiple_array
+
+
+@pytest.fixture(scope="module")
+def merge_inputs():
+    """A 10-replica marked-merge batch (4 distinct streams tiled), plus the
+    resident single-launch reference output."""
+    replicas, capacity = 10, 512
+    workload = make_merge_workload(doc_len=120, ops_per_merge=24, num_streams=4,
+                                   with_marks=True, seed=7)
+    batch = build_device_batch(workload, replicas, capacity, 64)
+    sp = prepare_sorted_batch([batch["text_ops"][r] for r in range(replicas)])
+    inputs = {
+        "states": jax.tree.map(np.asarray, batch["states"]),
+        "text": sp["text"],
+        "rounds": sp["rounds"],
+        "num_rounds": sp["num_rounds"],
+        "marks": batch["mark_ops"],
+        "ranks": batch["ranks"],
+        "bufs": sp["bufs"],
+        "maxk": sp["maxk"],
+    }
+    resident = K.merge_step_sorted_batch(
+        batch["states"],
+        jax.numpy.asarray(sp["text"]),
+        jax.numpy.asarray(sp["rounds"]),
+        sp["num_rounds"],
+        jax.numpy.asarray(batch["mark_ops"]),
+        jax.numpy.asarray(inputs["ranks"]),
+        jax.numpy.asarray(sp["bufs"]),
+        sp["maxk"],
+    )
+    digests = np.asarray(
+        K.convergence_digest_batch(
+            resident,
+            jax.numpy.asarray(inputs["ranks"]),
+            jax.numpy.asarray(allow_multiple_array()),
+        )
+    )
+    return inputs, jax.tree.map(np.asarray, resident), digests
+
+
+def _stream(inputs, **kw):
+    return stream_merge_sorted(
+        inputs["states"], inputs["text"], inputs["rounds"], inputs["num_rounds"],
+        inputs["marks"], inputs["ranks"], inputs["bufs"], inputs["maxk"], **kw
+    )
+
+
+def assert_states_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.parametrize("cohort", [4, 3, 10, 64])
+def test_stream_matches_resident(merge_inputs, cohort):
+    """Even cohorts, a padded tail (3 and 4 into 10), cohort == R, and
+    cohort > R must all reproduce the resident merge bit-for-bit."""
+    inputs, resident, digests = merge_inputs
+    out, dg, stats = _stream(inputs, cohort=cohort)
+    np.testing.assert_array_equal(dg, digests)
+    assert_states_equal(out, resident)
+    assert stats["n_cohorts"] == -(-10 // min(cohort, 10))
+
+
+def test_stream_depth_one(merge_inputs):
+    """depth=1 (no overlap: drain each cohort before the next launch) is the
+    same computation, just unpipelined."""
+    inputs, resident, digests = merge_inputs
+    out, dg, _ = _stream(inputs, cohort=4, depth=1)
+    np.testing.assert_array_equal(dg, digests)
+    assert_states_equal(out, resident)
+
+
+def test_stream_over_mesh(merge_inputs):
+    """Cohorts device_put with replica x seq NamedShardings over the virtual
+    8-device mesh: same bits as the unsharded resident merge."""
+    inputs, resident, digests = merge_inputs
+    mesh = make_mesh(jax.devices(), 4, 2)
+    out, dg, _ = _stream(inputs, cohort=4, mesh=mesh)
+    np.testing.assert_array_equal(dg, digests)
+    assert_states_equal(out, resident)
+
+
+def test_stream_mesh_rounds_cohort_to_replica_axis(merge_inputs):
+    """A cohort that doesn't divide over the replica mesh axis is rounded
+    up (the tail pad fills), instead of crashing deep inside device_put."""
+    inputs, resident, digests = merge_inputs
+    mesh = make_mesh(jax.devices(), 4, 2)
+    out, dg, stats = _stream(inputs, cohort=3, mesh=mesh)
+    assert stats["cohort"] % 4 == 0
+    np.testing.assert_array_equal(dg, digests)
+    assert_states_equal(out, resident)
+
+
+def test_stream_completion_token_without_digest(merge_inputs):
+    """compute_digest=False: the digest slot must carry post-merge lengths
+    (the readback barrier still depends on the merge output)."""
+    inputs, resident, _ = merge_inputs
+    out, tokens, _ = _stream(inputs, cohort=4, compute_digest=False)
+    np.testing.assert_array_equal(tokens, np.asarray(resident.length).astype(np.uint32))
+    assert_states_equal(out, resident)
+
+
+def test_stream_no_state_readback(merge_inputs):
+    """readback_states=False still returns correct digests (the streaming
+    digest-only mode for pure convergence sweeps)."""
+    inputs, _, digests = merge_inputs
+    out, dg, _ = _stream(inputs, cohort=4, readback_states=False)
+    assert out is None
+    np.testing.assert_array_equal(dg, digests)
+
+
+def test_cohort_budget_math():
+    """The budget helper reproduces BASELINE.md's residency arithmetic:
+    C=16384/M=1024 state is ~4.25 MiB/replica, and the cohort estimate
+    scales linearly with devices and inversely with depth."""
+    sb = state_bytes_per_replica(16384, 1024)
+    assert abs(sb / 2**20 - 4.25) < 0.1
+    one = cohort_for_budget(16384, 1024, ops_len=64, depth=2, n_devices=1)
+    eight = cohort_for_budget(16384, 1024, ops_len=64, depth=2, n_devices=8)
+    shallow = cohort_for_budget(16384, 1024, ops_len=64, depth=1, n_devices=1)
+    assert eight == pytest.approx(8 * one, rel=0.01)
+    assert shallow == pytest.approx(2 * one, rel=0.01)
+    # The streamed cohort (x2 in flight) must fit where the resident
+    # budget-table population does: 2 * cohort * state < 90% HBM.
+    assert 2 * one * sb < 0.9 * 16 * 2**30
