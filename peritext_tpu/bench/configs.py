@@ -223,7 +223,7 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
         )
         stream_total_ops = int(per_stream[tile].sum())
 
-        def stream(shift, rows):
+        def stream(shift, rows, readback):
             return stream_merge_sorted(
                 jax.tree.map(lambda a: a[:rows], states_np),
                 shift_op_ids(text_np[:rows], shift, genesis_max),
@@ -235,12 +235,27 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
                 sp["maxk"],
                 cohort=stream_cohort,
                 mesh=mesh,
+                readback_states=readback,
             )
 
-        stream(1_000_000, min(stream_cohort, replicas))  # compile on one cohort
+        # CONFIG5_STREAM_READBACK=1 includes the full per-cohort D2H state
+        # readback in the timed pass (the population-update round trip);
+        # the default 0 measures the digest-only convergence sweep — at
+        # north-star scale a dense host copy of the OUTPUT population is
+        # its own resource question (the input rides a broadcast here).
+        readback = os.environ.get("CONFIG5_STREAM_READBACK", "0") == "1"
+        stream(1_000_000, min(stream_cohort, replicas), readback)  # compile
         start = time.perf_counter()
-        out_states, digests, stats = stream(2_000_000, replicas)
+        out_states, digests, stats = stream(2_000_000, replicas, readback)
         merge_s = time.perf_counter() - start
+        if not readback:
+            # Recover just the flatten leg's cohort (same op-id shift, so
+            # these states equal the timed pass's first-cohort output).
+            # Use the EFFECTIVE cohort (stats) — it may have been rounded
+            # up to the replica mesh axis.
+            out_states, _, _ = stream(
+                2_000_000, min(stats["cohort"], replicas), True
+            )
         for r in range(n_streams, replicas):
             assert digests[r] == digests[r % n_streams], "config5 stream diverged"
 
@@ -249,17 +264,18 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
         # effective cohort (stats) is already a replica-axis multiple; clamp
         # to the population by padding with row 0, mirroring the stream's
         # own tail handling, so shard_states always divides evenly.
-        rows = min(stats["cohort"], replicas)
-        pad_to = -(-rows // int(mesh.shape["replica"])) * int(mesh.shape["replica"])
+        avail = min(
+            stats["cohort"], replicas, jax.tree.leaves(out_states)[0].shape[0]
+        )
+        rows = -(-avail // int(mesh.shape["replica"])) * int(mesh.shape["replica"])
 
         def cohort_rows(a):
-            sl = np.asarray(a[:rows])
-            if pad_to > rows:
-                fill = np.broadcast_to(sl[0:1], (pad_to - rows,) + sl.shape[1:])
+            sl = np.asarray(a[:avail])
+            if rows > avail:
+                fill = np.broadcast_to(sl[0:1], (rows - avail,) + sl.shape[1:])
                 sl = np.concatenate([sl, fill], axis=0)
             return jnp.asarray(sl)
 
-        rows = pad_to
         cohort_states = shard_states(jax.tree.map(cohort_rows, out_states), mesh)
         flatten = flatten_sources_sp(mesh)
 
@@ -288,6 +304,7 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
             "merge_seconds": round(merge_s, 4),
             "cohort": stats["cohort"],
             "n_cohorts": stats["n_cohorts"],
+            "state_readback_timed": readback,
             "flatten_chars_per_sec_per_cohort": round(rows * doc_len / flatten_s, 1),
             "platform": jax.devices()[0].platform,
             "conditions": measurement_conditions(),

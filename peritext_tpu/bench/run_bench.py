@@ -108,6 +108,10 @@ def main() -> None:
             result["patched_ops_per_sec"] = round(p["ops_per_sec"], 1)
             result["patched_replicas"] = p["replicas"]
             result["patched_path"] = p["path"]
+            # One fresh-universe ingest measures the cache-COLD regime
+            # (dominance init included); the editor-fleet steady state is
+            # cache-WARM (time_patched_fleet below).
+            result["patched_regime"] = "cold_single_ingest"
             # The common pure-typing ingest (no mark rows): compiles the
             # static mark-free fast path, no winner-cache init or scan.
             p_typing = time_patched_merge(with_marks=False)
@@ -119,6 +123,28 @@ def main() -> None:
             sys.stdout.flush()
         except Exception as err:
             print(f"bench: patched measurement failed: {err}", file=sys.stderr)
+        # Editor-fleet steady state (VERDICT r4 item 4): cache-cold vs
+        # cache-warm on ONE universe, plus the no-patch gap.  Its own try +
+        # incremental print, so a failure or supervisor timeout here can
+        # never discard the patched legs already on stdout above.
+        try:
+            from peritext_tpu.bench.workloads import time_patched_fleet
+
+            fleet = time_patched_fleet()
+            result["patched_cold_ops_per_sec"] = round(
+                fleet["patched_cold_ops_per_sec"], 1
+            )
+            result["patched_warm_ops_per_sec"] = round(
+                fleet["patched_warm_ops_per_sec"], 1
+            )
+            result["fleet_no_patch_ops_per_sec"] = round(
+                fleet["no_patch_ops_per_sec"], 1
+            )
+            result["warm_vs_no_patch"] = round(fleet["warm_vs_no_patch"], 3)
+            print(json.dumps(result))
+            sys.stdout.flush()
+        except Exception as err:
+            print(f"bench: fleet measurement failed: {err}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -33,11 +33,14 @@ _DTYPE_BYTES = {
     "s4": 1, "u4": 1, "s2": 1, "u2": 1, "f8": 1,
 }
 
-# Instruction outputs that do not materialize a new HBM buffer.
+# Instruction outputs that do not materialize a new HBM buffer.  NOTE:
+# custom-call is deliberately COUNTED — Pallas/Mosaic kernels lower to
+# custom-calls whose outputs are real HBM buffers (sharding-annotation
+# custom-calls only appear in unoptimized HLO, which this tool never sees).
 _FREE_OPS = {
     "parameter", "tuple", "get-tuple-element", "bitcast", "constant",
     "while", "conditional", "call", "after-all", "partition-id",
-    "replica-id", "custom-call",  # custom-calls here are only annotations
+    "replica-id",
 }
 
 _SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16)\[([\d,]*)\]")
