@@ -244,6 +244,7 @@ def fuzz(
     nested: bool = False,
     report_every: int = 0,
     growth: bool = False,
+    growth_target: int = 2000,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -292,7 +293,14 @@ def fuzz(
         target = rng.randrange(len(docs))
         doc = docs[target]
         if growth:
-            kinds = ["insert", "insert", "insert", "remove", "addMark", "removeMark"]
+            # 3:1 insert bias until the doc reaches the sustain target,
+            # then 1:2 so the soak HOLDS a realistic length indefinitely
+            # (unbounded growth would slow the O(n) oracle + patch checks
+            # to a crawl and stop exercising delete/valve paths).
+            if _text_len(doc) < growth_target:
+                kinds = ["insert", "insert", "insert", "remove", "addMark", "removeMark"]
+            else:
+                kinds = ["insert", "remove", "remove", "addMark", "removeMark"]
         else:
             kinds = ["insert", "remove", "addMark", "removeMark"]
         if nested:
@@ -395,14 +403,20 @@ def _main() -> None:
     parser.add_argument("iters", nargs="?", type=int, default=1000)
     parser.add_argument("seed", nargs="?", type=int, default=0)
     parser.add_argument(
-        "--engine", choices=["oracle", "tpu"], default="oracle",
-        help="doc factory under test (tpu = TpuDoc differential vs oracle semantics)",
+        "--engine", choices=["oracle", "tpu", "mixed"], default="oracle",
+        help="doc factory under test (tpu = all TpuDoc; mixed = alternating "
+        "oracle/TpuDoc replicas — the strongest cross-engine differential)",
     )
     parser.add_argument("--nested", action="store_true", help="also fuzz nested objects")
     parser.add_argument(
         "--growth", action="store_true",
         help="growth-biased op profile: docs reach/sustain 1k+ chars "
         "(exercises capacity growth, chunk valves, group-cap fallbacks)",
+    )
+    parser.add_argument(
+        "--growth-target", type=int, default=2000,
+        help="doc length the growth profile sustains (insert-biased below, "
+        "delete-biased above)",
     )
     parser.add_argument(
         "--report-every", type=int, default=1000,
@@ -418,14 +432,21 @@ def _main() -> None:
     )
     args = parser.parse_args()
 
-    if args.engine == "tpu":
+    if args.engine in ("tpu", "mixed"):
         if args.platform != "ambient":
             import jax
 
             jax.config.update("jax_platforms", args.platform)
         from peritext_tpu.ops.doc import TpuDoc
 
-        factory: Callable[[str], Any] = TpuDoc
+        if args.engine == "mixed":
+            flip = itertools.cycle([TpuDoc, Doc])
+
+            def factory(actor_id: str) -> Any:
+                return next(flip)(actor_id)
+
+        else:
+            factory: Callable[[str], Any] = TpuDoc
     else:
         factory = Doc
     try:
@@ -436,6 +457,7 @@ def _main() -> None:
             nested=args.nested,
             report_every=args.report_every,
             growth=args.growth,
+            growth_target=args.growth_target,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")

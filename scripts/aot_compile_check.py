@@ -14,7 +14,7 @@ block size.
 
 Usage:
     python scripts/aot_compile_check.py            # all kernels
-    python scripts/aot_compile_check.py text|mark|full
+    python scripts/aot_compile_check.py text|mark|full|latency
 
 Numerical verification still needs the chip (PERITEXT_TEST_PLATFORM=axon
 pytest tests/test_pallas.py); this only proves compilation.
@@ -109,9 +109,40 @@ def main() -> int:
             sds(cbuf, row)
         ).compile()
 
-    checks = {"text": check_text, "mark": check_mark, "full": check_full}
+    def check_latency():
+        # The launch-bound R=1 regime (PROFILE_r04 conclusion 4 fix (b)):
+        # merge_step_pallas at the 10k-char latency shape — C=16384 text
+        # planes VMEM-resident (the full-VMEM mark kernel does NOT fit at
+        # this shape: [8, 2C, W=32] is 32 MiB, so the latency path pairs
+        # the Pallas text phase with the XLA mark tail).
+        lat = build_device_batch(
+            workload, num_replicas=8 * n_dev, capacity=16384, max_mark_ops=1024
+        )
+        lat_text = jnp.asarray(lat["text_ops"])
+        lat_marks = jnp.asarray(lat["mark_ops"])
+        lat_cbuf = jnp.zeros((8 * n_dev, 16384), jnp.int32)
+        g = functools.partial(PK.merge_step_pallas, interpret=False)
+        f = shard_map(
+            g,
+            mesh=mesh,
+            in_specs=(P("x"), P("x"), P("x"), P(), P("x")),
+            out_specs=P("x"),
+            check_vma=False,
+        )
+        st_sds = jax.tree.map(lambda x: sds(x, row), lat["states"])
+        jax.jit(f).lower(
+            st_sds, sds(lat_text, row), sds(lat_marks, row), sds(ranks, repl),
+            sds(lat_cbuf, row)
+        ).compile()
+
+    checks = {
+        "text": check_text,
+        "mark": check_mark,
+        "full": check_full,
+        "latency": check_latency,
+    }
     if which != "all" and which not in checks:
-        print(f"usage: {sys.argv[0]} [text|mark|full|all] (got {which!r})")
+        print(f"usage: {sys.argv[0]} [text|mark|full|latency|all] (got {which!r})")
         return 2
     names = list(checks) if which == "all" else [which]
     for name in names:

@@ -4,6 +4,8 @@ Runs in interpret mode on CPU; on a TPU backend (platform "tpu" or the
 relayed "axon") the same tests compile under Mosaic — run with
 PERITEXT_TEST_PLATFORM=axon for the hardware verification pass.
 """
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -114,3 +116,36 @@ def test_pallas_rejects_misaligned_shapes():
             jnp.asarray(batch["ranks"]),
             interpret=None,
         )
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PERITEXT_SLOW"),
+    reason="latency-shape interpret run is slow; PERITEXT_SLOW=1 opt-in",
+)
+def test_pallas_latency_shape_matches_xla():
+    """The launch-bound latency configuration (PROFILE_r04 conclusion 4 fix
+    (b)): one 8-replica block at the 10k-char shape (C=16384) through
+    merge_step_pallas — VMEM-resident text phase + XLA mark tail, the exact
+    program BENCH_PALLAS=1 measures in time_merge_latency — must equal the
+    XLA merge field-for-field.  (The full-VMEM mark kernel does not fit at
+    this shape: [8, 2C, 32] words is 32 MiB; merge_step_pallas is the
+    latency path by design.)"""
+    import dataclasses
+
+    workload = make_merge_workload(
+        doc_len=10_000, ops_per_merge=64, num_streams=2, with_marks=True, seed=3
+    )
+    batch = build_device_batch(
+        workload, num_replicas=8, capacity=16384, max_mark_ops=1024
+    )
+    text_ops = jnp.asarray(batch["text_ops"])
+    mark_ops = jnp.asarray(batch["mark_ops"])
+    ranks = jnp.asarray(batch["ranks"])
+    states = batch["states"]
+
+    ref = K.merge_step_batch(states, text_ops, mark_ops, ranks)
+    out = merge_step_pallas(states, text_ops, mark_ops, ranks, interpret=None)
+    for field in dataclasses.fields(ref):
+        a = np.asarray(getattr(ref, field.name))
+        b = np.asarray(getattr(out, field.name))
+        assert (a == b).all(), f"field {field.name} diverged"
