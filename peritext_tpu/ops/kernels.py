@@ -974,7 +974,8 @@ def _or_accumulate(mask: jax.Array, bit_rows: jax.Array) -> jax.Array:
 
 
 def _apply_marks_batch(
-    bnd_def, bnd_mask, mark_ops, elem_ctr, elem_act, length, mark_count, w_words
+    bnd_def, bnd_mask, mark_ops, elem_ctr, elem_act, length, mark_count, w_words,
+    perm=None,
 ):
     """Apply a causally-ordered mark-op batch to the boundary tables at once.
 
@@ -985,6 +986,12 @@ def _apply_marks_batch(
     ``currentOps`` semantics, resolved for all ops simultaneously.
     Bit-exact with scanning _apply_mark_fast over the same rows (differential
     coverage in tests/test_sorted_merge.py).  Returns (bnd_def, bnd_mask).
+
+    ``perm`` (the text phase's orig-index plane) composes the post-splice
+    boundary permutation INTO this phase's reads instead of materializing a
+    permuted [2C, W] plane first (_permute_boundaries): every access to the
+    old tables goes through ``old_rows``/``def_p``, so the full-width plane
+    is read once and written once for the whole phase.
     """
     m_ops = mark_ops.shape[0]
     c = elem_ctr.shape[0]
@@ -994,6 +1001,29 @@ def _apply_marks_batch(
     slots = jnp.arange(two_c, dtype=jnp.int32)
     ar = jnp.arange(c, dtype=jnp.int32)
     live = ar < length
+
+    if perm is not None:
+        pvalid = perm >= 0  # [C]
+        psafe = jnp.maximum(perm, 0)
+        # Flat slot-axis composition (post-splice slot -> pre-splice slot):
+        # one single-axis gather per use, no [C, 2, W] view reshapes (those
+        # cost the compiler full-plane layout copies).
+        def_p = jnp.where(
+            pvalid[slots // 2], bnd_def[2 * psafe[slots // 2] + slots % 2], False
+        )
+
+        def old_rows(slot_idx):  # [N] post-splice slots -> [N, W] old rows
+            return jnp.where(
+                pvalid[slot_idx // 2][:, None],
+                bnd_mask[2 * psafe[slot_idx // 2] + slot_idx % 2],
+                jnp.uint32(0),
+            )
+
+    else:
+        def_p = bnd_def
+
+        def old_rows(slot_idx):
+            return bnd_mask[slot_idx]
 
     valid = mark_ops[:, K_KIND] == KIND_MARK  # [M]
 
@@ -1018,16 +1048,29 @@ def _apply_marks_batch(
     e_slot = jnp.where(e_slot == s_slot, big, e_slot)  # same-slot -> endOfText
 
     # Bit rows: op m's table index is mark_count + (rank among valid rows).
+    # The batch's new bits all land in a narrow WORD WINDOW of the [.., W]
+    # plane — at most ceil(M/32)+1 words starting at mark_count//32 — so
+    # every batch-bit tensor (B, segment ORs, the accumulation matmuls, the
+    # tail plane) is built at window width w_act instead of the full table
+    # width W.  At the bench shape (W=32, ~22 mark rows -> w_act=2) this
+    # removes the dominant HBM traffic of the whole merge: the [2C, 2W] f32
+    # accumulate plane and several full-width [2C, W] intermediates
+    # (roofline_r05: see PROFILE notes).  Only the carry ROOT rows (pre-
+    # batch rows, bits anywhere) stay full-width, and they ride the tiny
+    # [2M, W] node table.
     mpos = jnp.cumsum(valid.astype(jnp.int32)) - 1
     bit_idx = mark_count + mpos  # [M]
-    word_ar = jnp.arange(w_words, dtype=jnp.int32)
+    w_act = min((m_ops + MASK_WORD_BITS - 1) // MASK_WORD_BITS + 1, w_words)
+    w0 = jnp.clip(mark_count // MASK_WORD_BITS, 0, w_words - w_act)
+    bit_off = bit_idx - w0 * MASK_WORD_BITS  # window-relative, in [0, 32*w_act)
+    word_ar = jnp.arange(w_act, dtype=jnp.int32)
     B = jnp.where(
-        valid[:, None] & (word_ar[None, :] == bit_idx[:, None] // MASK_WORD_BITS),
-        jnp.uint32(1) << (bit_idx[:, None] % MASK_WORD_BITS).astype(jnp.uint32),
+        valid[:, None] & (word_ar[None, :] == bit_off[:, None] // MASK_WORD_BITS),
+        jnp.uint32(1) << (bit_off[:, None] % MASK_WORD_BITS).astype(jnp.uint32),
         jnp.uint32(0),
-    )  # [M, W]
+    )  # [M, w_act]
 
-    d0 = bnd_def & (slots < 2 * length)  # defined before the batch
+    d0 = def_p & (slots < 2 * length)  # defined before the batch
 
     writes_s = valid & (s_slot < e_slot)
     writes_e = valid & (e_slot < two_c)
@@ -1050,7 +1093,7 @@ def _apply_marks_batch(
     in_range_t = in_range.T  # [2C, M]
     w_any_t = w_any.T
 
-    def carry_node(p):  # p [M] target slots -> (q, prev, seg_base)
+    def carry_node(p):  # p [M] target slots -> (q, prev, seg bits, root row)
         # Nearest slot defined before this op's turn.
         cand = (slots[None, :] <= p[:, None]) & (def_time[None, :] < midx[:, None])
         q = jnp.max(jnp.where(cand, slots[None, :], -1), axis=1)  # [M]
@@ -1062,18 +1105,19 @@ def _apply_marks_batch(
         # Bits ORed into q between prev and this op (in-range, defined).
         seg = in_range_t[qc] & (q >= 0)[:, None]
         seg = seg & (midx[None, :] > prev[:, None]) & (midx[None, :] < midx[:, None])
-        seg_bits = _or_accumulate(seg, B)
-        # Root base: q's pre-batch row when no batch op rebased it first.
+        seg_bits = _or_accumulate(seg, B)  # [M, w_act] window bits
+        # Root base: q's pre-batch row when no batch op rebased it first
+        # (full-width — pre-batch bits live anywhere in the table).
         root_row = jnp.where(
             ((prev < 0) & (q >= 0))[:, None] & d0[qc][:, None],
-            bnd_mask[qc],
+            old_rows(qc),
             jnp.uint32(0),
         )
-        return q, prev, seg_bits | root_row
+        return q, prev, seg_bits, root_row
 
-    q_s, prev_s, base_s = carry_node(s_slot)
+    q_s, prev_s, seg_s, root_s = carry_node(s_slot)
     e_clamped = jnp.minimum(e_slot, jnp.int32(two_c - 1))
-    q_e, prev_e, base_e = carry_node(e_clamped)
+    q_e, prev_e, seg_e, root_e = carry_node(e_clamped)
 
     # Node table: node m = op m's S-write row, node M+m = its E-write row.
     def parent_node(prev, q):
@@ -1081,7 +1125,11 @@ def _apply_marks_batch(
         is_s = s_slot[jnp.maximum(prev, 0)] == q
         return jnp.where(prev < 0, -1, jnp.where(is_s, prev, prev + m_ops))
 
-    acc = jnp.concatenate([base_s | B, base_e], axis=0)  # [2M, W]
+    # Split accumulation: window bits [2M, w_act] and full-width root rows
+    # [2M, W] (each chain has exactly one root node carrying a nonzero
+    # root_row; the OR-propagation delivers it to every chain member).
+    acc_win = jnp.concatenate([seg_s | B, seg_e], axis=0)
+    acc_root = jnp.concatenate([root_s, root_e], axis=0)
     ptr = jnp.concatenate([parent_node(prev_s, q_s), parent_node(prev_e, q_e)])
 
     # Pointer doubling: fold each node's ancestor chain into its value.
@@ -1089,22 +1137,59 @@ def _apply_marks_batch(
     steps = max(1, (n_nodes - 1).bit_length())
     for _ in range(steps):
         pc = jnp.maximum(ptr, 0)
-        acc = acc | jnp.where((ptr >= 0)[:, None], acc[pc], jnp.uint32(0))
+        chained = (ptr >= 0)[:, None]
+        acc_win = acc_win | jnp.where(chained, acc_win[pc], jnp.uint32(0))
+        acc_root = acc_root | jnp.where(chained, acc_root[pc], jnp.uint32(0))
         ptr = jnp.where(ptr >= 0, ptr[pc], ptr)
 
-    # Per-slot final rows.
+    # Per-slot final rows.  Full-width pass: written slots are REBASED to
+    # their writer's root row (replacing the old row in every word);
+    # everything else keeps its old row.  Window pass: the batch's new bits
+    # (rebase chain + tail) OR into the w_act active words only.
     wl = jnp.maximum(w_last, 0)
     node_at = jnp.where(s_slot[wl] == slots, wl, wl + m_ops)
-    rebased_row = acc[node_at]  # [2C, W]
-    base_rows = jnp.where(
-        written_any[:, None], rebased_row, jnp.where(d0[:, None], bnd_mask, jnp.uint32(0))
-    )
+    written_col = written_any[:, None]
+    # Expand the tiny [2M, W] root table to written slots as a static OR-
+    # select chain instead of a [2C]-index gather: the chain stays inside
+    # the one full-plane output fusion (a gather materializes its own
+    # [2C, W] plane), and the merge is bandwidth-bound ~300:1, so the
+    # extra 2M broadcast selects are free VPU work.  Guarded: HLO size and
+    # trace time scale with the (padded) node count, so unusually deep
+    # mark batches fall back to the gather.
+    if n_nodes <= 128:
+        root_at = jnp.uint32(0)
+        for n in range(n_nodes):
+            root_at = root_at | jnp.where(
+                (node_at == n)[:, None], acc_root[n], jnp.uint32(0)
+            )
+    else:
+        root_at = acc_root[node_at]
+    base_full = jnp.where(written_col, root_at, old_rows(slots))
     start_time = jnp.where(written_any, w_last, -1)
     tail_mask = in_range_t & (midx[None, :] > start_time[:, None])  # [2C, M]
-    tail = _or_accumulate(tail_mask, B)
-    touched = written_any | (d0 & tail_mask.any(axis=1))
-    new_mask = jnp.where(touched[:, None], base_rows | tail, bnd_mask)
-    new_def = bnd_def | written_any
+    tail_w = _or_accumulate(tail_mask, B)  # [2C, w_act]
+    # Tail bits apply to written rows and to pre-defined rows only (the
+    # walk never marks undefined slots) — the old full-width `touched`
+    # gate, expressed per window word.  The window delta is scattered back
+    # over the word axis with a broadcast compare + tiny-axis gather (both
+    # fuse into the single full-plane output pass; a dynamic_update_slice
+    # here costs full-plane layout copies instead).
+    delta = (
+        jnp.where(written_col, acc_win[node_at], jnp.uint32(0))
+        | jnp.where(written_col | d0[:, None], tail_w, jnp.uint32(0))
+    )  # [2C, w_act]
+    # Scatter the window back over the word axis as w_act static broadcast-
+    # selects (w_act is ~2) — pure elementwise, fuses into the single full-
+    # plane output pass; a word-axis gather here lowers to an extra
+    # W-major plane materialization.
+    word_full = jnp.arange(w_words, dtype=jnp.int32)
+    expanded = jnp.uint32(0)
+    for j in range(w_act):
+        expanded = expanded | jnp.where(
+            word_full[None, :] == w0 + j, delta[:, j][:, None], jnp.uint32(0)
+        )
+    new_mask = base_full | expanded
+    new_def = def_p | written_any
     return new_def, new_mask
 
 
@@ -1131,18 +1216,19 @@ def _append_mark_table(state_fields, mark_ops, mark_count, m_cap):
 def _sorted_tail(
     state: DocState, elem_ctr, elem_act, deleted, chars, orig_idx, length, mark_ops
 ) -> DocState:
-    """Post-placement tail shared by the sorted merges: boundary permute +
-    batched mark phase + table append, per replica."""
-    bnd_def, bnd_mask = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+    """Post-placement tail shared by the sorted merges: batched mark phase
+    (with the boundary permute composed into its reads) + table append, per
+    replica."""
     bnd_def, bnd_mask = _apply_marks_batch(
-        bnd_def,
-        bnd_mask,
+        state.bnd_def,
+        state.bnd_mask,
         mark_ops,
         elem_ctr,
         elem_act,
         length,
         state.mark_count,
         state.bnd_mask.shape[-1],
+        perm=orig_idx,
     )
     mark_ctr, mark_act, mark_action, mark_type, mark_attr, mark_count = _append_mark_table(
         (state.mark_ctr, state.mark_act, state.mark_action, state.mark_type, state.mark_attr),

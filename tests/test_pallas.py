@@ -126,11 +126,14 @@ def test_pallas_latency_shape_matches_xla():
     """The launch-bound latency configuration (PROFILE_r04 conclusion 4 fix
     (b)): one 8-replica block at the 10k-char shape (C=16384) through
     merge_step_pallas — VMEM-resident text phase + XLA mark tail, the exact
-    program BENCH_PALLAS=1 measures in time_merge_latency — must equal the
-    XLA merge field-for-field.  (The full-VMEM mark kernel does not fit at
+    program BENCH_PALLAS=1 measures in time_merge_latency, INCLUDING its
+    fused KIND_INSERT_RUN rows + char buffer — must equal the XLA fused
+    merge field-for-field.  (The full-VMEM mark kernel does not fit at
     this shape: [8, 2C, 32] words is 32 MiB; merge_step_pallas is the
     latency path by design.)"""
     import dataclasses
+
+    from peritext_tpu.ops.encode import fuse_insert_runs, pad_buffer
 
     workload = make_merge_workload(
         doc_len=10_000, ops_per_merge=64, num_streams=2, with_marks=True, seed=3
@@ -138,13 +141,21 @@ def test_pallas_latency_shape_matches_xla():
     batch = build_device_batch(
         workload, num_replicas=8, capacity=16384, max_mark_ops=1024
     )
-    text_ops = jnp.asarray(batch["text_ops"])
-    mark_ops = jnp.asarray(batch["mark_ops"])
+    # Mirror time_merge_latency's prep: replica 0's stream, fused, tiled
+    # over the 8-replica block.
+    fr, fb, _ = fuse_insert_runs(batch["text_ops"][0])
+    text_ops = jnp.asarray(np.repeat(fr[None, ...], 8, axis=0))
+    char_bufs = jnp.asarray(
+        np.repeat(pad_buffer(fb, max(fb.shape[0], K.MAX_RUN_LEN))[None, ...], 8, axis=0)
+    )
+    mark_ops = jnp.asarray(np.repeat(batch["mark_ops"][0][None, ...], 8, axis=0))
     ranks = jnp.asarray(batch["ranks"])
     states = batch["states"]
 
-    ref = K.merge_step_batch(states, text_ops, mark_ops, ranks)
-    out = merge_step_pallas(states, text_ops, mark_ops, ranks, interpret=None)
+    ref = K.merge_step_fused_batch(states, text_ops, mark_ops, ranks, char_bufs)
+    out = merge_step_pallas(
+        states, text_ops, mark_ops, ranks, char_buf=char_bufs, interpret=None
+    )
     for field in dataclasses.fields(ref):
         a = np.asarray(getattr(ref, field.name))
         b = np.asarray(getattr(out, field.name))
