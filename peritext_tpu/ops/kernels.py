@@ -515,17 +515,25 @@ def _apply_text_op(carry, op, ranks, char_buf=None):
     return new_carry, None
 
 
+def _slot_permutation(orig_idx):
+    """Flat slot-axis form of a text phase's element permutation:
+    ``(valid [2C], flat_src [2C])`` mapping each post-splice boundary slot
+    to its pre-splice slot.  THE one definition for every plane that rides
+    the splice (boundary tables, winner cache) — and deliberately flat:
+    a [C, 2, ...]-view gather costs the compiler full-plane layout copies
+    (PROFILE_r05.md)."""
+    c = orig_idx.shape[0]
+    slots = jnp.arange(2 * c, dtype=jnp.int32)
+    valid = (orig_idx >= 0)[slots // 2]
+    flat_src = 2 * jnp.maximum(orig_idx, 0)[slots // 2] + slots % 2
+    return valid, flat_src
+
+
 def _permute_boundaries(bnd_def, bnd_mask, orig_idx):
     """Re-align boundary tables after a text phase, in one gather."""
-    c = orig_idx.shape[0]
-    valid = orig_idx >= 0
-    safe = jnp.maximum(orig_idx, 0)
-    def2 = bnd_def.reshape(c, 2)
-    mask2 = bnd_mask.reshape(c, 2, -1)
-    new_def = jnp.where(valid[:, None], def2[safe], False).reshape(2 * c)
-    new_mask = jnp.where(valid[:, None, None], mask2[safe], jnp.uint32(0)).reshape(
-        2 * c, -1
-    )
+    valid, flat_src = _slot_permutation(orig_idx)
+    new_def = jnp.where(valid, bnd_def[flat_src], False)
+    new_mask = jnp.where(valid[:, None], bnd_mask[flat_src], jnp.uint32(0))
     return new_def, new_mask
 
 
@@ -1003,19 +1011,15 @@ def _apply_marks_batch(
     live = ar < length
 
     if perm is not None:
-        pvalid = perm >= 0  # [C]
-        psafe = jnp.maximum(perm, 0)
         # Flat slot-axis composition (post-splice slot -> pre-splice slot):
-        # one single-axis gather per use, no [C, 2, W] view reshapes (those
-        # cost the compiler full-plane layout copies).
-        def_p = jnp.where(
-            pvalid[slots // 2], bnd_def[2 * psafe[slots // 2] + slots % 2], False
-        )
+        # one single-axis gather per use (_slot_permutation).
+        pvalid, pflat = _slot_permutation(perm)
+        def_p = jnp.where(pvalid, bnd_def[pflat], False)
 
         def old_rows(slot_idx):  # [N] post-splice slots -> [N, W] old rows
             return jnp.where(
-                pvalid[slot_idx // 2][:, None],
-                bnd_mask[2 * psafe[slot_idx // 2] + slot_idx % 2],
+                pvalid[slot_idx][:, None],
+                bnd_mask[pflat[slot_idx]],
                 jnp.uint32(0),
             )
 
@@ -1571,17 +1575,18 @@ def _winner_cache_init(bnd_mask0, mark_cols, ranks, n_types, max_mark_ops, multi
 
 def _permute_wcache(wcache, orig_idx):
     """Re-align a [2C, T, 4] winner cache after a text phase, mirroring
-    _permute_boundaries: batch-born elements' slots come up empty."""
-    c = orig_idx.shape[0]
-    valid = orig_idx >= 0
-    safe = jnp.maximum(orig_idx, 0)
-    wc2 = wcache.reshape(c, 2, wcache.shape[-2], 4)
-    out = jnp.where(
-        valid[:, None, None, None],
-        wc2[safe],
-        jnp.array([-1, -1, 0, 0], jnp.int32)[None, None, None, :],
+    _permute_boundaries: batch-born elements' slots come up empty.
+
+    Flat single-axis slot gather (no [C, 2, T, 4] view): the view-reshaped
+    gather cost the compiler SIX full-plane layout copies of the [2C, T, 4]
+    cache — 1.5 GiB/launch at the bench shape, the threaded patched path's
+    single largest traffic source (PROFILE_r05.md)."""
+    valid, flat_src = _slot_permutation(orig_idx)
+    return jnp.where(
+        valid[:, None, None],
+        wcache[flat_src],
+        jnp.array([-1, -1, 0, 0], jnp.int32)[None, None, :],
     )
-    return out.reshape(2 * c, wcache.shape[-2], 4)
 
 
 def _group_topk_cols(mark_type_col, mark_attr_col, op, k: int):
