@@ -242,3 +242,89 @@ def test_scatter_splice_matches_sort_splice(monkeypatch):
         monkeypatch.setattr(K, "_SPLICE_MODE", mode)
         out = run()
         assert_states_equal(ref, out, f"{mode} vs default splice")
+
+
+def test_mark_window_clamps_at_table_end():
+    """The r5 word-windowed mark accumulation clamps its window when
+    mark_count sits in the table's last words (w0 = clip(count//32,
+    0, W - w_act)): marks landing there must still be bit-exact with the
+    sequential scan.  Builds a replica whose table holds 100 ops (of 128,
+    W=4), then merges one more batch through both paths."""
+    import random
+
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+
+    base = Doc("base")
+    genesis, _ = base.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("y" * 200)},
+        ]
+    )
+    w = Doc("w")
+    w.apply_change(genesis)
+    rng = random.Random(13)
+
+    def mark_batch(n):
+        changes = []
+        for i in range(n):
+            a = rng.randrange(0, 150)
+            add = bool(i % 4)
+            mt = rng.choice(["strong", "em", "link"] if add else ["strong", "em"])
+            op = {
+                "path": ["text"],
+                "action": "addMark" if add else "removeMark",
+                "startIndex": a,
+                "endIndex": a + 1 + rng.randrange(40),
+                "markType": mt,
+            }
+            if mt == "link":
+                op["attrs"] = {"url": "u.com"}
+            ch, _ = w.change([op])
+            changes.append(ch)
+        return changes
+
+    actors, attrs = ActorRegistry(), AttrRegistry()
+    g_rows, _, _ = encode_changes([genesis], actors, attrs)
+    text_obj = genesis["ops"][0]["opId"]
+    ranks = np.zeros(64, np.int32)
+    st = stack_states([make_empty_state(512, 128)])
+    g_text, g_marks = split_rows(g_rows)
+    sp0 = prepare_sorted_batch([g_text])
+    gmr = np.zeros((1, max(g_marks.shape[0], 1), K.OP_FIELDS), np.int32)
+    gmr[0, : g_marks.shape[0]] = g_marks
+    rk = actors.ranks()
+    ranks[: len(rk)] = rk
+    st = K.merge_step_sorted_batch(
+        st, jnp.asarray(sp0["text"]), jnp.asarray(sp0["rounds"]), sp0["num_rounds"],
+        jnp.asarray(gmr), jnp.asarray(ranks), jnp.asarray(sp0["bufs"]), sp0["maxk"],
+    )
+
+    def ingest_both(st_in, changes):
+        rows, _, _ = encode_changes(changes, actors, attrs, text_obj=text_obj)
+        t, m = split_rows(rows)
+        rk = actors.ranks()
+        ranks[: len(rk)] = rk
+        sp = prepare_sorted_batch([t])
+        srt = K.merge_step_sorted_batch(
+            st_in, jnp.asarray(sp["text"]), jnp.asarray(sp["rounds"]),
+            sp["num_rounds"], jnp.asarray(m[None, ...]), jnp.asarray(ranks),
+            jnp.asarray(sp["bufs"]), sp["maxk"],
+        )
+        scn = K.merge_step_batch(
+            st_in, jnp.asarray(t[None, ...]), jnp.asarray(m[None, ...]),
+            jnp.asarray(ranks),
+        )
+        return srt, scn
+
+    # Fill to mark_count=100; the fill rounds double as free differential
+    # coverage at mark_count 25/50/75/100 (windows sliding up the table).
+    for i in range(4):
+        srt, scn = ingest_both(st, mark_batch(25))
+        assert_states_equal(srt, scn, f"fill round {i}")
+        st = srt
+    assert int(np.asarray(st.mark_count)[0]) == 100
+
+    # The batch under test: its window starts in the table's final words.
+    srt, scn = ingest_both(st, mark_batch(10))
+    assert_states_equal(srt, scn, "clamped window")
