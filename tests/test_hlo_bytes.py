@@ -1,0 +1,49 @@
+"""The HLO output-sum scorer (scripts/hlo_bytes.py) — the round-5 traffic
+metric — must parse shapes, skip free ops and fusion bodies, and count
+custom-calls (Pallas kernels)."""
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "hlo_bytes",
+    os.path.join(os.path.dirname(__file__), "..", "scripts", "hlo_bytes.py"),
+)
+hlo_bytes = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_spec and hlo_bytes)
+
+_SAMPLE = """\
+HloModule jit_merge, entry_computation_layout={()->f32[]}
+
+%fused_computation.1 (p0: s32[8,16]) -> s32[8,16] {
+  %p0 = s32[8,16]{1,0} parameter(0)
+  ROOT %inner = s32[8,16]{1,0} add(%p0, %p0)
+}
+
+ENTRY %main.1 (arg: u32[256,4096,32]) -> u32[256,4096,32] {
+  %arg = u32[256,4096,32]{1,2,0} parameter(0)
+  %big = u32[256,4096,32]{1,2,0} fusion(%arg), kind=kLoop, calls=%fused_computation.1
+  %cc = f32[128,128]{1,0} custom-call(%big), custom_call_target="tpu_custom_call"
+  %gte = u32[256,4096,32]{1,2,0} get-tuple-element(%big), index=0
+  ROOT %out = u32[256,4096,32]{1,2,0} copy(%big)
+}
+"""
+
+
+def test_score_counts_materializing_ops_only(tmp_path):
+    p = tmp_path / "dump.txt"
+    p.write_text(_SAMPLE)
+    result = hlo_bytes.score(str(p), per_op=True)
+    plane = 256 * 4096 * 32 * 4  # the u32 plane
+    cc = 128 * 128 * 4  # the custom-call output (Pallas kernels count)
+    # fusion + copy count; parameter/get-tuple-element don't; the fusion
+    # BODY's add (inside %fused_computation.1) doesn't.
+    assert result["output_sum_bytes"] == 2 * plane + cc
+    assert result["by_opcode_mib"]["fusion"] == round(plane / 2**20, 1)
+    assert "custom-call" in result["by_opcode_mib"]
+
+
+def test_shape_bytes_tuple_and_unknown_dtypes():
+    assert hlo_bytes.shape_bytes("(pred[4,8], s32[2])") == 4 * 8 + 2 * 4
+    assert hlo_bytes.shape_bytes("bf16[10]") == 20
+    # unknown dtype tokens are skipped, not fatal
+    assert hlo_bytes.shape_bytes("c64[4]") == 0
