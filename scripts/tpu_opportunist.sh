@@ -82,6 +82,11 @@ run_steps() {
   probe || return 1
   step config5.json 3600 python3 -m peritext_tpu.bench.configs --config 5 --platform ambient --timeout 3500 || return 1
   probe || return 1
+  # 7. The north-star route on silicon: population past HBM residency,
+  # streamed in cohorts (r5; BASELINE.md "chosen route").
+  step config5_stream.json 3600 env CONFIG5_REPLICAS=8192 CONFIG5_STREAM_COHORT=2048 \
+    python3 -m peritext_tpu.bench.configs --config 5 --platform ambient --timeout 3500 || return 1
+  probe || return 1
   step bench_r4096.json 2100 env BENCH_REPLICAS=4096 BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
   return 0
 }
