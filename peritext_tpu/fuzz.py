@@ -245,6 +245,7 @@ def fuzz(
     report_every: int = 0,
     growth: bool = False,
     growth_target: int = 2000,
+    clear_caches_every: int = 0,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -257,6 +258,13 @@ def fuzz(
     bounded deletes) so documents reach and sustain realistic lengths —
     the regime that actually exercises capacity growth, the chunk valves,
     and group-cap fallbacks under adversarial schedules.
+
+    ``clear_caches_every`` drops JAX's compilation caches every N
+    iterations (0 = never).  Long growth soaks mint a fresh program per
+    distinct (capacity, batch-shape) pair; unbounded, the accumulated
+    compiled programs exhaust process memory/mappings after a few hundred
+    iterations ("LLVM compilation error: Cannot allocate memory") — the
+    periodic clear trades recompiles for a bounded footprint.
 
     With ``nested``, a share of iterations drive the host structural plane
     (nested makeMap/makeList/set/del, second-list edits and marks) and every
@@ -290,6 +298,13 @@ def fuzz(
 
     done = 0
     for done in itertools.count(1) if iterations == 0 else range(1, iterations + 1):
+        # Clear BEFORE op generation: a no-op iteration's `continue` must
+        # not skip a scheduled clear (the interval this knob bounds is the
+        # accumulation margin before allocation failure).
+        if clear_caches_every and done % clear_caches_every == 0:
+            import jax
+
+            jax.clear_caches()
         target = rng.randrange(len(docs))
         doc = docs[target]
         if growth:
@@ -419,6 +434,11 @@ def _main() -> None:
         "delete-biased above)",
     )
     parser.add_argument(
+        "--clear-caches-every", type=int, default=0,
+        help="drop JAX compilation caches every N iterations (bounds a "
+        "long soak's per-shape program accumulation; 0 = never)",
+    )
+    parser.add_argument(
         "--report-every", type=int, default=1000,
         help="progress line every N iterations (0 = silent)",
     )
@@ -458,6 +478,7 @@ def _main() -> None:
             report_every=args.report_every,
             growth=args.growth,
             growth_target=args.growth_target,
+            clear_caches_every=args.clear_caches_every,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")
