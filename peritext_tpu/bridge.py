@@ -16,17 +16,20 @@ Editor "steps" mirror ProseMirror's step vocabulary:
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from peritext_tpu.oracle import Doc
-from peritext_tpu.runtime import ChangeQueue, Publisher
-from peritext_tpu.runtime.sync import apply_changes
+from peritext_tpu.runtime import ChangeQueue, Publisher, faults
+from peritext_tpu.runtime.sync import apply_available, apply_changes
 from peritext_tpu.schema import MARK_SPEC
 
 Patch = Dict[str, Any]
 Step = Tuple
+
+_log = logging.getLogger(__name__)
 
 
 def describe_op(op: Dict[str, Any]) -> str:
@@ -118,6 +121,9 @@ class Editor:
         self.on_remote_patch = on_remote_patch
         self.comments: Dict[str, Comment] = {}
         self.change_log: List[Dict[str, Any]] = []
+        # Causally-unready remote changes waiting for their dependencies
+        # (the delivery retry buffer; see _receive_changes).
+        self._pending: List[Dict[str, Any]] = []
         # Doc mutation guard for interval-driven mode: the queue timer
         # delivers remote changes on its own thread while the caller may be
         # mid-change() (or mid-read) on the same docs.  Defaults to the
@@ -131,6 +137,9 @@ class Editor:
             handle_flush=self._publish_changes,
             interval=interval,
             flush_lock=self.lock,
+            # Stable chaos stream key: injected queue_flush faults stay
+            # per-editor and reproducible regardless of construction order.
+            name=doc.actor_id,
         )
         publisher.subscribe(doc.actor_id, self._receive_changes)
 
@@ -216,13 +225,52 @@ class Editor:
     # -- inbound -----------------------------------------------------------
 
     def _receive_changes(self, changes: Sequence[Dict[str, Any]]) -> None:
+        err: Optional[BaseException] = None
         with self.lock:
-            patches = apply_changes(self.doc, list(changes))
+            # Gap-tolerant retry buffer (the reference applyChanges queue,
+            # test/merge.ts:4-23, kept across deliveries): under chaotic
+            # delivery a dropped or held-back change must not turn every
+            # later publish into an exception inside the subscriber callback
+            # — causally-unready changes wait here and apply as soon as
+            # their dependencies arrive; duplicates drop idempotently.
+            queued = self._pending + list(changes)
+            try:
+                patches, self._pending = apply_available(self.doc, queued)
+            except Exception as exc:
+                # A non-causal mid-batch failure: changes before the failing
+                # one DID apply — their patches (tagged on the exception by
+                # apply_available) must still reach the view callbacks, or a
+                # patch-driven consumer permanently misses content the doc
+                # now contains (redelivery dedupes them).  The unapplied
+                # remainder stays buffered for the next delivery — EXCEPT a
+                # permanently-failing (non-transient) poison change, which
+                # sits at the buffer's head and would otherwise wedge the
+                # whole inbound path forever; it is dropped and surfaced.
+                patches = list(getattr(exc, "applied_patches", ()))
+                remaining = list(getattr(exc, "unapplied", queued))
+                if (
+                    remaining
+                    and hasattr(exc, "unapplied")
+                    and not faults.retryable(exc)
+                ):
+                    poison = remaining.pop(0)
+                    _log.warning(
+                        "dropping permanently-failing change %s@%s from the "
+                        "delivery buffer (%s: %s)",
+                        poison.get("actor"),
+                        poison.get("seq"),
+                        type(exc).__name__,
+                        exc,
+                    )
+                self._pending = remaining
+                err = exc
         for patch in patches:
             if self.on_patch:
                 self.on_patch(patch)
             if self.on_remote_patch:
                 self.on_remote_patch(patch)
+        if err is not None:
+            raise err
 
     # -- views ---------------------------------------------------------------
 

@@ -26,12 +26,20 @@ import random
 from typing import Any, Callable, Dict, List, Optional
 
 from peritext_tpu.oracle import Doc, accumulate_patches
+from peritext_tpu.runtime.faults import FaultPlan
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.sync import apply_changes
 from peritext_tpu.testing import generate_docs
 
 MARK_TYPES = ["strong", "em", "link", "comment"]
 EXAMPLE_URLS = [f"{c}.com" for c in "ABCDEFGHIJKLMNOPQRSTUVWXYZ"]
+
+# Delivery chaos applied by --chaos between replicas: a quarter of messages
+# dropped, a fifth duplicated, a quarter held back/reordered.  Convergence is
+# asserted at fault-free quiesce points (every ``chaos_quiesce`` iterations),
+# where full anti-entropy from the durable log must restore byte-identical
+# replicas — the paper's claim under adversarial delivery.
+DEFAULT_CHAOS_SPEC = "pubsub_deliver:drop=0.25,dup=0.2,reorder=0.25"
 
 
 class FuzzError(AssertionError):
@@ -246,6 +254,8 @@ def fuzz(
     growth: bool = False,
     growth_target: int = 2000,
     clear_caches_every: int = 0,
+    chaos: Optional[str] = None,
+    chaos_quiesce: int = 8,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -266,6 +276,17 @@ def fuzz(
     iterations ("LLVM compilation error: Cannot allocate memory") — the
     periodic clear trades recompiles for a bounded footprint.
 
+    With ``chaos`` (a fault spec, e.g. :data:`DEFAULT_CHAOS_SPEC`), every
+    pairwise sync's deliveries run through the spec's ``pubsub_deliver``
+    schedule — drops, duplicates and reorders, seeded from ``seed`` — and
+    causally-unready survivors are left pending instead of asserted.  Every
+    ``chaos_quiesce`` iterations a fault-free full anti-entropy pass from
+    the durable log quiesces the fleet, and the standard convergence and
+    patch/batch asserts must then hold for *every* replica.  An installed
+    process-wide fault plan (``faults.install`` / ``PERITEXT_FAULTS``) can
+    additionally inject device-launch faults, driving the engine's
+    retry/degradation machinery under the same differential asserts.
+
     With ``nested``, a share of iterations drive the host structural plane
     (nested makeMap/makeList/set/del, second-list edits and marks) and every
     sync additionally asserts root-view and nested-list-span convergence.
@@ -278,6 +299,9 @@ def fuzz(
     rng = random.Random(seed)
     if nested:
         check_patches = False
+    if chaos and chaos_quiesce < 1:
+        raise ValueError(f"chaos_quiesce must be >= 1, got {chaos_quiesce}")
+    chaos_plan = FaultPlan.from_spec(chaos, seed=seed) if chaos else None
     docs, all_patches, initial_change = generate_docs(initial_text, num_docs)
     if doc_factory is not Doc:
         # Rebuild replicas with the engine under test from the genesis change.
@@ -296,7 +320,57 @@ def fuzz(
         }
         raise FuzzError(message, state)
 
+    def check_pair(a: int, b: int) -> None:
+        a_spans = docs[a].get_text_with_formatting(["text"])
+        b_spans = docs[b].get_text_with_formatting(["text"])
+        if check_patches:
+            for side, spans in ((a, a_spans), (b, b_spans)):
+                accumulated = accumulate_patches(all_patches[side])
+                if accumulated != spans:
+                    fail(
+                        f"patch/batch de-sync on {docs[side].actor_id}",
+                        {"patchDoc": accumulated, "batchDoc": spans},
+                    )
+        if docs[a].clock != docs[b].clock:
+            fail("clock divergence", {"left": dict(docs[a].clock), "right": dict(docs[b].clock)})
+        if a_spans != b_spans:
+            fail("span divergence", {"left": a_spans, "right": b_spans})
+        if nested:
+            a_root = docs[a].root
+            b_root = docs[b].root
+            if a_root != b_root:
+                fail(
+                    "root-view divergence",
+                    {"left": repr(a_root), "right": repr(b_root)},
+                )
+            # Marked nested lists: spans must agree too (marks are
+            # invisible in the plain root view).  Reuses the snapshot
+            # just compared.
+            for path in _discover_objects(a_root)["lists"]:
+                ls = docs[a].get_text_with_formatting(path)
+                rs = docs[b].get_text_with_formatting(path)
+                if ls != rs:
+                    fail(
+                        f"nested span divergence at {path}",
+                        {"left": ls, "right": rs},
+                    )
+
+    def quiesce_and_check() -> None:
+        """Fault-free full anti-entropy from the durable log, then the
+        standard convergence/patch asserts for EVERY replica."""
+        frontier = log.clock()
+        for i, d in enumerate(docs):
+            all_patches[i].extend(
+                apply_changes(d, log.missing_changes(frontier, d.clock))
+            )
+        for i in range(1, len(docs)):
+            check_pair(0, i)
+
     done = 0
+    # True while chaotic syncs have happened since the last fault-free
+    # quiesce (drives both the heartbeat wording and the mandatory final
+    # quiesce — `done % chaos_quiesce` alone misses a no-op last iteration).
+    chaos_unverified = False
     for done in itertools.count(1) if iterations == 0 else range(1, iterations + 1):
         # Clear BEFORE op generation: a no-op iteration's `continue` must
         # not skip a scheduled clear (the interval this knob bounds is the
@@ -351,53 +425,61 @@ def fuzz(
             right = rng.randrange(len(docs))
         syncs.append({"left": docs[left].actor_id, "right": docs[right].actor_id})
 
-        all_patches[right].extend(
-            apply_changes(docs[right], log.missing_changes(docs[left].clock, docs[right].clock))
-        )
-        all_patches[left].extend(
-            apply_changes(docs[left], log.missing_changes(docs[right].clock, docs[left].clock))
-        )
-
-        left_spans = docs[left].get_text_with_formatting(["text"])
-        right_spans = docs[right].get_text_with_formatting(["text"])
-
-        if check_patches:
-            for side, spans in ((left, left_spans), (right, right_spans)):
-                accumulated = accumulate_patches(all_patches[side])
-                if accumulated != spans:
-                    fail(
-                        f"patch/batch de-sync on {docs[side].actor_id}",
-                        {"patchDoc": accumulated, "batchDoc": spans},
-                    )
-        if docs[left].clock != docs[right].clock:
-            fail("clock divergence", {"left": dict(docs[left].clock), "right": dict(docs[right].clock)})
-        if left_spans != right_spans:
-            fail("span divergence", {"left": left_spans, "right": right_spans})
-        if nested:
-            left_root = docs[left].root
-            right_root = docs[right].root
-            if left_root != right_root:
-                fail(
-                    "root-view divergence",
-                    {"left": repr(left_root), "right": repr(right_root)},
-                )
-            # Marked nested lists: spans must agree too (marks are invisible
-            # in the plain root view).  Reuses the snapshot just compared.
-            for path in _discover_objects(left_root)["lists"]:
-                ls = docs[left].get_text_with_formatting(path)
-                rs = docs[right].get_text_with_formatting(path)
-                if ls != rs:
-                    fail(
-                        f"nested span divergence at {path}",
-                        {"left": ls, "right": rs},
-                    )
-        # Progress AFTER the iteration's checks: a soak line never claims
-        # an iteration that hasn't fully converged.
+        if chaos_plan is not None:
+            # Chaotic delivery: each direction's missing-changes stream runs
+            # through the pubsub_deliver schedule (per-receiver holdback
+            # buffers), and causal gaps are tolerated — the durable log
+            # redelivers on a later sync.
+            to_right = chaos_plan.filter_stream(
+                "pubsub_deliver",
+                log.missing_changes(docs[left].clock, docs[right].clock),
+                stream=docs[right].actor_id,
+            )
+            to_left = chaos_plan.filter_stream(
+                "pubsub_deliver",
+                log.missing_changes(docs[right].clock, docs[left].clock),
+                stream=docs[left].actor_id,
+            )
+            all_patches[right].extend(apply_changes(docs[right], to_right, allow_gaps=True))
+            all_patches[left].extend(apply_changes(docs[left], to_left, allow_gaps=True))
+            # Convergence is only claimable at quiesce points; other
+            # iterations stay chaotic and unverified.
+            chaos_unverified = True
+            verified = done % chaos_quiesce == 0
+            if verified:
+                quiesce_and_check()
+                chaos_unverified = False
+        else:
+            all_patches[right].extend(
+                apply_changes(docs[right], log.missing_changes(docs[left].clock, docs[right].clock))
+            )
+            all_patches[left].extend(
+                apply_changes(docs[left], log.missing_changes(docs[right].clock, docs[left].clock))
+            )
+            check_pair(left, right)
+            verified = True
+        # Progress AFTER the iteration's checks: a soak line only claims
+        # "ok" for iterations that actually converged — chaotic
+        # non-quiesce iterations still emit a heartbeat (a wedged soak must
+        # stay distinguishable from a slow one) but say so.
         if report_every and done % report_every == 0:
             length = sum(
                 len(s["text"]) for s in docs[0].get_text_with_formatting(["text"])
             )
-            print(f"fuzz: {done} iterations ok, doc length {length}", flush=True)
+            if verified:
+                print(f"fuzz: {done} iterations ok, doc length {length}", flush=True)
+            else:
+                print(
+                    f"fuzz: {done} iterations (chaotic; convergence pending "
+                    f"next quiesce), doc length {length}",
+                    flush=True,
+                )
+
+    if chaos_plan is not None and chaos_unverified:
+        # Final quiesce: the run must never end on unchecked chaotic
+        # iterations (or with deliveries still in the holdback buffers) —
+        # a success return means every replica converged at the end.
+        quiesce_and_check()
 
     return {
         "docs": docs,
@@ -423,6 +505,16 @@ def _main() -> None:
         "oracle/TpuDoc replicas — the strongest cross-engine differential)",
     )
     parser.add_argument("--nested", action="store_true", help="also fuzz nested objects")
+    parser.add_argument(
+        "--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC, default=None, metavar="SPEC",
+        help="chaotic delivery between replicas (fault spec; bare flag uses "
+        f"{DEFAULT_CHAOS_SPEC!r}); convergence asserted at fault-free "
+        "quiesce points",
+    )
+    parser.add_argument(
+        "--chaos-quiesce", type=int, default=8,
+        help="iterations between fault-free quiesce/assert passes under --chaos",
+    )
     parser.add_argument(
         "--growth", action="store_true",
         help="growth-biased op profile: docs reach/sustain 1k+ chars "
@@ -479,6 +571,8 @@ def _main() -> None:
             growth=args.growth,
             growth_target=args.growth_target,
             clear_caches_every=args.clear_caches_every,
+            chaos=args.chaos,
+            chaos_quiesce=args.chaos_quiesce,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")

@@ -26,10 +26,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+import copy
+
 from peritext_tpu.ids import make_op_id
 from peritext_tpu.ops import kernels as K
+from peritext_tpu.runtime import faults
 from peritext_tpu.ops.state import index_state, stack_states
-from peritext_tpu.ops.universe import TpuUniverse, assemble_patches
+from peritext_tpu.ops.universe import TpuUniverse, _retryable, assemble_patches
 from peritext_tpu.oracle.doc import (
     ROOT,
     generate_input_op,
@@ -50,6 +53,9 @@ class TpuDoc:
         self._actor_int = self._uni.actors.intern(actor_id)
         self.seq = 0
         self.max_op = 0
+        # Control-plane snapshot for the duration of one change() call (the
+        # launch-failure rollback); None outside change().
+        self._snap: Optional[Dict[str, Any]] = None
 
     # -- views ---------------------------------------------------------------
 
@@ -137,24 +143,88 @@ class TpuDoc:
     # -- local change generation ---------------------------------------------
 
     def change(self, input_ops: Sequence[Dict[str, Any]]) -> Tuple[Change, List[Patch]]:
-        deps = dict(self.clock)
-        # Seq resumes from our own clock entry after log-replay recovery
-        # (same rule as oracle.Doc.change; see its comment).
-        self.seq = max(self.seq, self.clock.get(self.actor_id, 0)) + 1
-        self._uni.clocks[0][self.actor_id] = self.seq
-        change: Change = {
-            "actor": self.actor_id,
+        uni = self._uni
+        # Snapshot the whole control plane up front: local generation
+        # commits clocks/seq/lengths/census *before* each device launch, so
+        # a launch that exhausts its retry budget mid-change would otherwise
+        # leave this actor's stream permanently ahead of its state (every
+        # peer rejecting the next seq forever).  Device state is an
+        # immutable pytree and the store copy is taken lazily on the first
+        # host op, so the snapshot is cheap for pure text changes.
+        snap: Dict[str, Any] = {
             "seq": self.seq,
-            "deps": deps,
-            "startOp": self.max_op + 1,
-            "ops": [],
+            "max_op": self.max_op,
+            "clock_entry": uni.clocks[0].get(self.actor_id),
+            "states": uni.states,
+            # Capacities travel WITH the states pytree: _ensure_capacity may
+            # grow both mid-change, and restoring one without the other
+            # leaves the universe skipping resizes (silent out-of-bounds
+            # scatters) on the next change.
+            "capacity": uni.capacity,
+            "max_mark_ops": uni.max_mark_ops,
+            "length": uni.lengths[0],
+            "marks": uni.mark_counts[0],
+            "census": {k: set(v) for k, v in uni._multi_groups.items()},
+            "wcaches": uni._wcaches,
+            "wcaches_actors": uni._wcaches_actors,
+            "store": None,  # deepcopied by _make_host_op before first host op
+            "store_version": uni.store_versions[0],
+            "text_obj": uni.text_objs[0],
         }
-        patches: List[Patch] = []
-        for input_op in input_ops:
-            patches.extend(self._generate_input_op(change, input_op))
-        return change, patches
+        self._snap = snap
+        try:
+            deps = dict(self.clock)
+            # Seq resumes from our own clock entry after log-replay recovery
+            # (same rule as oracle.Doc.change; see its comment).
+            self.seq = max(self.seq, self.clock.get(self.actor_id, 0)) + 1
+            uni.clocks[0][self.actor_id] = self.seq
+            change: Change = {
+                "actor": self.actor_id,
+                "seq": self.seq,
+                "deps": deps,
+                "startOp": self.max_op + 1,
+                "ops": [],
+            }
+            patches: List[Patch] = []
+            for input_op in input_ops:
+                patches.extend(self._generate_input_op(change, input_op))
+            return change, patches
+        except Exception as exc:
+            # Backend-side failure (retry exhaustion, an injected fault, or
+            # a raw backend error from an un-retried device query like the
+            # _elem_id anchor resolution): the change never happened.
+            # Restore every control-plane mirror so the actor's stream stays
+            # contiguous (semantic errors — bad indices etc. — deliberately
+            # keep the oracle's behavior and are not rolled back).
+            if not _retryable(exc):
+                raise
+            self.seq = snap["seq"]
+            self.max_op = snap["max_op"]
+            if snap["clock_entry"] is None:
+                uni.clocks[0].pop(self.actor_id, None)
+            else:
+                uni.clocks[0][self.actor_id] = snap["clock_entry"]
+            uni.states = snap["states"]
+            uni.capacity = snap["capacity"]
+            uni.max_mark_ops = snap["max_mark_ops"]
+            uni.lengths[0] = snap["length"]
+            uni.mark_counts[0] = snap["marks"]
+            uni._multi_groups = snap["census"]
+            uni._wcaches = snap["wcaches"]
+            uni._wcaches_actors = snap["wcaches_actors"]
+            if snap["store"] is not None:
+                uni.stores[0] = snap["store"]
+                uni.store_versions[0] = snap["store_version"]
+                uni.text_objs[0] = snap["text_obj"]
+            raise
+        finally:
+            self._snap = None
 
     def _elem_id(self, index: int, peek: bool) -> Tuple[int, int]:
+        # Anchor resolution is a device query: the bool() coercions below
+        # are host readbacks, the honest completion barrier on relayed
+        # backends — instrumented as such for chaos runs.
+        faults.fire("device_readback")
         ctr, act, found = K.visible_elem_id_jit(
             self._state(), jax.numpy.int32(index), jax.numpy.bool_(peek)
         )
@@ -296,6 +366,14 @@ class TpuDoc:
     def _make_host_op(self, change: Change, op: Dict[str, Any]) -> Tuple[str, List[Patch]]:
         """Allocate an op id, apply to the host store, record the wire form
         (the host-side half of the reference's makeNewOp, micromerge.ts:483-493)."""
+        if self._snap is not None and self._snap["store"] is None:
+            # First host op of this change: capture the pre-mutation store
+            # so a later launch failure can swap it back (store mutations
+            # are in-place on the local path).  Same cost model as ingest's
+            # _prepare copy-swap — host stores are tiny by design (the text
+            # data plane lives on device), and pure text changes never pay
+            # it.
+            self._snap["store"] = copy.deepcopy(self._store)
         self.max_op += 1
         op_id = make_op_id(self.max_op, self.actor_id)
         op_with_id = {"opId": op_id, **op}
@@ -335,12 +413,23 @@ class TpuDoc:
         # _group_topk_cols drops carry-bearing columns from its patches.
         uni._count_multi_groups(op_rows)
         state = self._state()
-        new_state, records = K.apply_ops_patched_jit(
-            state,
-            jax.numpy.asarray(op_rows),
-            jax.numpy.asarray(uni._ranks()),
-            jax.numpy.asarray(allow_multiple_array()),
-        )
+
+        # Local application runs under the same retry/backoff policy as
+        # ingest (the kernel call is pure — a failed attempt just reruns),
+        # but does NOT degrade: on retry exhaustion the DeviceLaunchError
+        # propagates to change(), whose snapshot rolls back every
+        # control-plane delta staged for this change.
+        def attempt():
+            faults.fire("device_launch")
+            ns, recs = K.apply_ops_patched_jit(
+                state,
+                jax.numpy.asarray(op_rows),
+                jax.numpy.asarray(uni._ranks()),
+                jax.numpy.asarray(allow_multiple_array()),
+            )
+            return (ns, recs), ns.length
+
+        new_state, records = uni._run_launch(attempt)
         uni.states = stack_states([new_state])
         # The local interleaved application rewrites boundary rows without
         # maintaining the patched sorted merge's winner cache.

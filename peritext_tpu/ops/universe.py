@@ -18,6 +18,7 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import logging
 import math
 import os
 import time
@@ -26,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from peritext_tpu.ids import ActorRegistry, make_op_id
+from peritext_tpu.ids import ActorRegistry, make_op_id, parse_op_id
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.encode import (
     AttrRegistry,
@@ -45,17 +46,64 @@ from peritext_tpu.ops.state import (
     stack_states,
 )
 from peritext_tpu.oracle.doc import (
+    ListItem,
     ObjectStore,
     get_list_element_id,
     get_text_with_formatting as oracle_spans,
     op_from_wire,
     ops_to_marks,
 )
+from peritext_tpu.runtime import faults
 from peritext_tpu.runtime.sync import causal_order
 from peritext_tpu import schema
 from peritext_tpu.schema import allow_multiple_array
 
 Change = Dict[str, Any]
+
+_log = logging.getLogger(__name__)
+
+
+class DeviceLaunchError(RuntimeError):
+    """A device launch kept failing after the configured retry budget.
+
+    ``__cause__`` / ``cause`` carry the last attempt's exception.  With
+    degradation enabled (the default) callers never see this for ingest —
+    the batch completes on the oracle CPU path instead.
+    """
+
+    def __init__(self, attempts: int, cause: Optional[BaseException]):
+        super().__init__(
+            f"device launch failed after {attempts} attempt(s): {cause!r}"
+        )
+        self.attempts = attempts
+        self.cause = cause
+
+
+def _launch_policy() -> Tuple[int, float, float]:
+    """(retries, backoff base seconds, per-attempt deadline seconds).
+
+    ``PERITEXT_LAUNCH_RETRIES`` extra attempts (default 2) with exponential
+    backoff ``PERITEXT_LAUNCH_BACKOFF * 2**i`` (default 0.05s, capped at 2s).
+    ``PERITEXT_LAUNCH_TIMEOUT`` > 0 adds a wall-clock deadline per attempt,
+    enforced around the host readback barrier (subprocess-free: the attempt
+    blocks on the readback, then the elapsed time is judged — a wedged
+    backend surfaces as a late readback, which the policy counts as a
+    failed attempt instead of committing behind it)."""
+    return (
+        int(os.environ.get("PERITEXT_LAUNCH_RETRIES", "2")),
+        float(os.environ.get("PERITEXT_LAUNCH_BACKOFF", "0.05")),
+        float(os.environ.get("PERITEXT_LAUNCH_TIMEOUT", "0")),
+    )
+
+
+def _degrade_enabled() -> bool:
+    return os.environ.get("PERITEXT_DEGRADE", "1") != "0"
+
+
+# Transient-failure classification (shared with the Editor's delivery
+# buffer; see faults.retryable): transient errors retry, semantic errors
+# propagate untouched.
+_retryable = faults.retryable
 
 
 def apply_host_op(store: ObjectStore, op: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -391,6 +439,11 @@ class TpuUniverse:
             "changes_ingested": 0,
             "duplicates_dropped": 0,
             "scan_fallbacks": 0,
+            # Resilience counters: extra launch attempts taken (retry
+            # policy) and batches that completed on the oracle CPU path
+            # after the retry budget was exhausted.
+            "launch_retries": 0,
+            "degraded_batches": 0,
             # Wall-clock split of apply_changes: host control plane
             # (gate/encode/fuse/pad/commit) vs launch *dispatch*.  JAX
             # dispatch is async — device execution lands on whichever later
@@ -501,6 +554,50 @@ class TpuUniverse:
         out = np.zeros(n, np.int32)
         out[: len(ranks)] = ranks
         return out
+
+    # -- resilient launch policy -------------------------------------------
+
+    def _run_launch(self, attempt, needs_barrier: bool = False):
+        """Run a device-launch attempt under the retry/backoff policy.
+
+        ``attempt()`` fires the ``device_launch`` site itself, runs the
+        kernel(s) against the *committed* (immutable) state pytree and
+        returns ``(result, barrier_leaf)`` — nothing it does mutates
+        ``self``, so a failed attempt needs no rollback: its result is
+        simply discarded and the next attempt reruns from the same inputs.
+
+        With ``needs_barrier`` (strict commit) or a configured
+        ``PERITEXT_LAUNCH_TIMEOUT``, each attempt blocks on a host readback
+        of ``barrier_leaf`` — the only honest completion signal on relayed
+        backends (CLAUDE.md: ``block_until_ready`` returns early there) —
+        and a late readback counts as a failed attempt.  After the budget
+        is exhausted, raises :class:`DeviceLaunchError` carrying the last
+        cause; callers then either degrade to the oracle CPU path or
+        propagate with the committed state untouched.
+        """
+        retries, backoff, timeout = _launch_policy()
+        last: Optional[BaseException] = None
+        for i in range(retries + 1):
+            if i:
+                self.stats["launch_retries"] += 1
+                time.sleep(min(backoff * (2 ** (i - 1)), 2.0))
+            t0 = time.monotonic()
+            try:
+                result, barrier_leaf = attempt()
+                if needs_barrier or timeout > 0:
+                    faults.fire("device_readback")
+                    np.asarray(barrier_leaf)
+                    if timeout > 0 and time.monotonic() - t0 > timeout:
+                        raise TimeoutError(
+                            f"device launch attempt exceeded the {timeout}s deadline"
+                        )
+            except Exception as exc:
+                if not _retryable(exc):
+                    raise
+                last = exc
+                continue
+            return result
+        raise DeviceLaunchError(retries + 1, last) from last
 
     # -- the causal gate (host) --------------------------------------------
 
@@ -702,6 +799,236 @@ class TpuUniverse:
             for key, ops in pending.items()
         )
 
+    # -- oracle degradation (the CPU fallback after retry exhaustion) --------
+
+    def _degrade_apply(self, prep: Dict[str, Any]) -> Dict[int, List[Any]]:
+        """Complete a prepared batch through the oracle CPU engine.
+
+        The resilience endgame: the device launch kept failing past its
+        retry budget, so the batch re-applies per replica through the host
+        :class:`ObjectStore` (the oracle's per-object dispatch — reference
+        micromerge.ts:534-608) and the result is written back into the
+        dense device arrays.  To callers a degraded ingest is
+        indistinguishable from a successful launch — same patches, same
+        clocks/lengths/roots, same device-plane state — just O(ops x
+        length) scalar Python instead of one kernel launch.
+
+        Steps, per replica with a non-empty gated batch:
+
+        1. Read back the *committed* pre-batch device plane (committed
+           data — this readback does not depend on the failed launch) and
+           materialize it into oracle list metadata inside a copy of the
+           replica's host store: elements -> :class:`ListItem` rows,
+           boundary bitsets -> op-id sets, the mark table -> ``mark_ops``
+           entries.
+        2. Apply every gated wire op sequentially via ``store.apply_op`` —
+           the literal oracle engine, so patches and final state carry
+           reference semantics by construction.
+        3. Convert the list back into dense arrays (new mark ops append to
+           the table in batch order, exactly as the kernel would) and
+           restore the store's device placeholder, so the staged store
+           equals what the non-degraded host-op path would have produced.
+
+        Nothing mutates ``self`` until every replica converts cleanly; a
+        mid-degrade failure therefore leaves the committed state untouched
+        (the same all-or-nothing contract as a launch).  Returns the
+        ``(pos, patch)`` stream per replica index and commits the batch.
+        """
+        groups, group_of = prep["groups"], prep["group_of"]
+        self.stats["degraded_batches"] += 1
+        _log.warning(
+            "device launch retry budget exhausted; ingesting %d change(s) "
+            "via the oracle CPU degradation path",
+            prep["ingested"],
+        )
+        # One committed-state readback for the whole fleet (np.array:
+        # writable host copies — these become the new device arrays).
+        elem_ctr = np.array(self.states.elem_ctr)
+        elem_act = np.array(self.states.elem_act)
+        deleted = np.array(self.states.deleted)
+        chars = np.array(self.states.chars)
+        bnd_def = np.array(self.states.bnd_def)
+        bnd_mask = np.array(self.states.bnd_mask)
+        mark_cols = {
+            f: np.array(getattr(self.states, "mark_" + f))
+            for f in ("ctr", "act", "action", "type", "attr")
+        }
+        length_col = np.array(self.states.length)
+        mark_count_col = np.array(self.states.mark_count)
+        words = bnd_mask.shape[-1]
+
+        out: Dict[int, List[Any]] = {}
+        staged: List[Tuple[int, ObjectStore]] = []
+        for r in range(len(self.replica_ids)):
+            g = groups[group_of[r]]
+            if not g["ordered"]:
+                out[r] = []
+                continue
+            store = copy.deepcopy(self.stores[r])
+            text_obj = g["text_obj"] if g["text_obj"] is not None else self.text_objs[r]
+            n_el = self.lengths[r]
+            n_mk = self.mark_counts[r]
+
+            # Ids of the existing mark table rows (bit m <=> table row m).
+            old_mark_ids = [
+                make_op_id(int(mark_cols["ctr"][r, m]), self.actors.actor(int(mark_cols["act"][r, m])))
+                for m in range(n_mk)
+            ]
+            char_of: Dict[str, int] = {}
+            injected_ids: List[str] = []
+            text_mark_new: List[str] = []
+
+            bound = text_obj is not None and isinstance(
+                store.metadata.get(text_obj), list
+            )
+            if bound:
+                # 1. Materialize the device plane into the store copy.
+                store.device_objects.discard(text_obj)
+                values = store.objects[text_obj]
+                values.clear()  # in place: the parent map aliases this list
+                meta: List[ListItem] = []
+                for i in range(n_el):
+                    eid = make_op_id(
+                        int(elem_ctr[r, i]), self.actors.actor(int(elem_act[r, i]))
+                    )
+                    item = ListItem(eid, eid, bool(deleted[r, i]))
+                    for side, p in (("before", 2 * i), ("after", 2 * i + 1)):
+                        if bnd_def[r, p]:
+                            row = bnd_mask[r, p]
+                            item.set_side(
+                                side,
+                                {
+                                    old_mark_ids[m]
+                                    for m in range(n_mk)
+                                    if row[m // 32] >> (m % 32) & 1
+                                },
+                            )
+                    meta.append(item)
+                    char_of[eid] = int(chars[r, i])
+                    if not item.deleted:
+                        values.append(chr(int(chars[r, i])))
+                store.metadata[text_obj] = meta
+                for m, op_id in enumerate(old_mark_ids):
+                    if op_id not in store.mark_ops:
+                        op: Dict[str, Any] = {
+                            "opId": op_id,
+                            "action": "addMark"
+                            if int(mark_cols["action"][r, m]) == 0
+                            else "removeMark",
+                            "markType": schema.ALL_MARKS[int(mark_cols["type"][r, m])],
+                        }
+                        attrs = self.attrs.decode(int(mark_cols["attr"][r, m]))
+                        if attrs is not None:
+                            op["attrs"] = attrs
+                        store.mark_ops[op_id] = op
+                        injected_ids.append(op_id)
+
+            # 2. Sequential oracle application of the whole gated batch.
+            pairs: List[Any] = []
+            pos = 0
+            for change in g["ordered"]:
+                for op in change["ops"]:
+                    pairs.extend((pos, p) for p in apply_host_op(store, op))
+                    if op.get("obj") == text_obj and op["action"] in (
+                        "addMark",
+                        "removeMark",
+                    ):
+                        text_mark_new.append(op["opId"])
+                    pos += 1
+
+            # 3. Convert the (possibly batch-created) text list back into
+            # dense device arrays and restore the placeholder.
+            if text_obj is not None and isinstance(store.metadata.get(text_obj), list):
+                final_meta: List[ListItem] = store.metadata[text_obj]
+                rows = g["rows"]
+                for row in rows:
+                    op_id = make_op_id(
+                        int(row[K.K_CTR]), self.actors.actor(int(row[K.K_ACT]))
+                    )
+                    if row[K.K_KIND] == K.KIND_INSERT:
+                        char_of[op_id] = int(row[K.K_PAYLOAD])
+                mark_rows = rows[rows[:, K.K_KIND] == K.KIND_MARK]
+                new_table_ids = old_mark_ids + [
+                    make_op_id(int(mr[K.K_CTR]), self.actors.actor(int(mr[K.K_ACT])))
+                    for mr in mark_rows
+                ]
+                if len(final_meta) != int(prep["new_lengths"][r]) or len(
+                    new_table_ids
+                ) != int(prep["new_mark_counts"][r]):
+                    raise RuntimeError(
+                        "oracle degradation produced inconsistent capacity "
+                        f"accounting for replica {self.replica_ids[r]!r}: "
+                        f"{len(final_meta)} elements (expected "
+                        f"{int(prep['new_lengths'][r])}), {len(new_table_ids)} "
+                        f"mark ops (expected {int(prep['new_mark_counts'][r])})"
+                    )
+                bit_of = {op_id: m for m, op_id in enumerate(new_table_ids)}
+                C = self.capacity
+                ec = np.zeros(C, np.int32)
+                ea = np.zeros(C, np.int32)
+                dl = np.zeros(C, bool)
+                ch = np.zeros(C, np.int32)
+                bd = np.zeros(2 * C, bool)
+                bm = np.zeros((2 * C, words), np.uint32)
+                for i, item in enumerate(final_meta):
+                    ctr_, actor_ = parse_op_id(item.elem_id)
+                    ec[i] = ctr_
+                    ea[i] = self.actors.id_of(actor_)
+                    dl[i] = item.deleted
+                    ch[i] = char_of[item.elem_id]
+                    for side, p in (("before", 2 * i), ("after", 2 * i + 1)):
+                        ops_set = item.get_side(side)
+                        if ops_set is not None:
+                            bd[p] = True
+                            for op_id in ops_set:
+                                m = bit_of[op_id]
+                                bm[p, m // 32] |= np.uint32(1 << (m % 32))
+                elem_ctr[r], elem_act[r] = ec, ea
+                deleted[r], chars[r] = dl, ch
+                bnd_def[r], bnd_mask[r] = bd, bm
+                for m, mr in enumerate(mark_rows, start=n_mk):
+                    mark_cols["ctr"][r, m] = int(mr[K.K_CTR])
+                    mark_cols["act"][r, m] = int(mr[K.K_ACT])
+                    mark_cols["action"][r, m] = int(mr[K.K_MACTION])
+                    mark_cols["type"][r, m] = int(mr[K.K_MTYPE])
+                    mark_cols["attr"][r, m] = int(mr[K.K_MATTR])
+                length_col[r] = len(final_meta)
+                mark_count_col[r] = len(new_table_ids)
+                # Restore the device placeholder: the staged store must
+                # equal what the non-degraded host-op path would stage.
+                store.objects[text_obj].clear()
+                store.metadata[text_obj] = []
+                store.device_objects.add(text_obj)
+                for op_id in injected_ids + text_mark_new:
+                    store.mark_ops.pop(op_id, None)
+            out[r] = pairs
+            staged.append((r, store))
+
+        # Everything converted cleanly: publish the device plane, stage the
+        # fully-applied stores (fresh version class per replica), commit.
+        self.states = DocState(
+            elem_ctr=jax.numpy.asarray(elem_ctr),
+            elem_act=jax.numpy.asarray(elem_act),
+            deleted=jax.numpy.asarray(deleted),
+            chars=jax.numpy.asarray(chars),
+            bnd_def=jax.numpy.asarray(bnd_def),
+            bnd_mask=jax.numpy.asarray(bnd_mask),
+            mark_ctr=jax.numpy.asarray(mark_cols["ctr"]),
+            mark_act=jax.numpy.asarray(mark_cols["act"]),
+            mark_action=jax.numpy.asarray(mark_cols["action"]),
+            mark_type=jax.numpy.asarray(mark_cols["type"]),
+            mark_attr=jax.numpy.asarray(mark_cols["attr"]),
+            length=jax.numpy.asarray(length_col),
+            mark_count=jax.numpy.asarray(mark_count_col),
+        )
+        self._wcaches = None  # boundary rows rewritten outside the kernels
+        for r, store in staged:
+            self._store_version_counter += 1
+            prep["new_stores"][r] = store
+            prep["new_store_versions"][r] = self._store_version_counter
+        self._commit(prep)
+        return out
+
     # -- ingestion ----------------------------------------------------------
 
     def _normalize_batches(
@@ -779,44 +1106,62 @@ class TpuUniverse:
         bufs = sorted_prep["bufs"][group_of]
         rounds = sorted_prep["rounds"][group_of]
         ranks = self._ranks()
-        self.stats["launches"] += 1
         pad_per_group = (sorted_prep["text"][:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1) + (
             g_mark[:, :, K.K_KIND] == K.KIND_PAD
         ).sum(axis=1)
         self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
         t_dev = time.perf_counter()
         self.stats["host_seconds"] += t_dev - t_host
-        if use_scan:
-            self.states = K.merge_step_fused_batch(
-                self.states,
-                jax.numpy.asarray(text_ops),
-                jax.numpy.asarray(mark_ops),
-                jax.numpy.asarray(ranks),
-                jax.numpy.asarray(bufs),
-            )
-        else:
-            self.states = K.merge_step_sorted_batch(
-                self.states,
-                jax.numpy.asarray(text_ops),
-                jax.numpy.asarray(rounds),
-                sorted_prep["num_rounds"],
-                jax.numpy.asarray(mark_ops),
-                jax.numpy.asarray(ranks),
-                jax.numpy.asarray(bufs),
-                sorted_prep["maxk"],
-            )
+
+        def attempt():
+            faults.fire("device_launch")
+            if use_scan:
+                st = K.merge_step_fused_batch(
+                    self.states,
+                    jax.numpy.asarray(text_ops),
+                    jax.numpy.asarray(mark_ops),
+                    jax.numpy.asarray(ranks),
+                    jax.numpy.asarray(bufs),
+                )
+            else:
+                st = K.merge_step_sorted_batch(
+                    self.states,
+                    jax.numpy.asarray(text_ops),
+                    jax.numpy.asarray(rounds),
+                    sorted_prep["num_rounds"],
+                    jax.numpy.asarray(mark_ops),
+                    jax.numpy.asarray(ranks),
+                    jax.numpy.asarray(bufs),
+                    sorted_prep["maxk"],
+                )
+            return st, st.length
+
+        # PERITEXT_STRICT_COMMIT=1: execution barrier before the
+        # control-plane commit.  JAX dispatch is async, so by default a
+        # launch that later fails on-device can leave committed clocks
+        # ahead of the state (surfacing at the next readback).  Strict mode
+        # trades pipelining for commit-after-*execution* — use it on flaky
+        # backends (e.g. the relayed TPU).  The barrier runs inside the
+        # retry attempt, so a readback failure consumes retry budget and
+        # leaves the committed state untouched.
+        strict = os.environ.get("PERITEXT_STRICT_COMMIT") == "1"
+        try:
+            new_states = self._run_launch(attempt, needs_barrier=strict)
+        except DeviceLaunchError:
+            if not _degrade_enabled():
+                raise  # committed state untouched: nothing was assigned
+            self._degrade_apply(prep)
+            self.stats["dispatch_seconds"] += time.perf_counter() - t_dev
+            return
+        self.states = new_states
+        # "launches" counts SUCCESSFUL kernel launches on every ingest path
+        # (failed attempts show up in launch_retries; degraded batches in
+        # degraded_batches), so launch/batch ratios are path-independent.
+        self.stats["launches"] += 1
         self.stats["dispatch_seconds"] += time.perf_counter() - t_dev
         # Non-patched merges rewrite boundary rows without maintaining the
         # patched path's winner cache.
         self._wcaches = None
-        if os.environ.get("PERITEXT_STRICT_COMMIT") == "1":
-            # Execution barrier before the control-plane commit: JAX
-            # dispatch is async, so by default a launch that later fails
-            # on-device can leave committed clocks ahead of the state
-            # (surfacing at the next readback).  Strict mode trades
-            # pipelining for commit-after-*execution* — use it on flaky
-            # backends (e.g. the relayed TPU).
-            np.asarray(self.states.length)
         t_host = time.perf_counter()
         self._commit(prep)
         self.stats["host_seconds"] += time.perf_counter() - t_host
@@ -949,13 +1294,17 @@ class TpuUniverse:
         # commits (same atomicity contract as the fast path).
         n = len(self.replica_ids)
         chunk = self._patch_chunk(n)
-        prev_states = self.states
-        try:
+
+        # The chunked loop is one resilient launch unit: each chunk's record
+        # readback happens inside the attempt, so a mid-loop failure simply
+        # discards the partial results (device state is immutable — the
+        # committed pytree is untouched until the whole attempt succeeds).
+        def attempt():
             state_slices = []
             record_chunks: List[Dict[str, np.ndarray]] = []
             for i in range(0, n, chunk):
                 sl = slice(i, min(i + chunk, n))
-                self.stats["launches"] += 1
+                faults.fire("device_launch")
                 st, records = K.apply_ops_patched_batch(
                     jax.tree.map(lambda x: x[sl], self.states),
                     jax.numpy.asarray(ops[sl]),
@@ -963,15 +1312,27 @@ class TpuUniverse:
                     multi,
                 )
                 state_slices.append(st)
+                faults.fire("device_readback")
                 record_chunks.append({k: np.asarray(v) for k, v in records.items()})
-            self.states = (
+            states = (
                 state_slices[0]
                 if len(state_slices) == 1
                 else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
             )
-        except Exception:
-            self.states = prev_states
-            raise
+            return (states, record_chunks), states.length
+
+        try:
+            new_states, record_chunks = self._run_launch(attempt)
+        except DeviceLaunchError:
+            if not _degrade_enabled():
+                raise
+            pairs = self._degrade_apply(prep)
+            return {
+                name: [p for _, p in pairs[r]]
+                for r, name in enumerate(self.replica_ids)
+            }
+        self.states = new_states
+        self.stats["launches"] += len(record_chunks)  # successful chunk launches
         # The interleaved path doesn't maintain the winner cache.
         self._wcaches = None
         self._commit(prep)
@@ -1033,7 +1394,6 @@ class TpuUniverse:
 
         n = len(self.replica_ids)
         chunk = self._patch_chunk(n)
-        prev_states = self.states
         # Static mark-free fast path: a pure-typing batch (no real mark
         # rows anywhere) compiles without the winner-cache init or the
         # mark scan.
@@ -1048,13 +1408,14 @@ class TpuUniverse:
             != (n, 2 * self.capacity, int(np.asarray(multi).shape[0]), 4)
         ):
             wc = None
-        try:
+
+        def attempt():
             state_slices = []
             record_chunks: List[Dict[str, np.ndarray]] = []
             wcache_slices = []
             for i in range(0, n, chunk):
                 sl = slice(i, min(i + chunk, n))
-                self.stats["launches"] += 1
+                faults.fire("device_launch")
                 st, records = K.merge_step_sorted_patched_batch(
                     jax.tree.map(lambda x: x[sl], self.states),
                     jax.numpy.asarray(text_ops[sl]),
@@ -1074,28 +1435,42 @@ class TpuUniverse:
                 # Keep the cache on device — reading it back would cost
                 # more than the init it saves.
                 wcache_slices.append(records.pop("wcache", None))
+                faults.fire("device_readback")
                 record_chunks.append({k: np.asarray(v) for k, v in records.items()})
-            self.states = (
+            states = (
                 state_slices[0]
                 if len(state_slices) == 1
                 else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
             )
             if all(w is not None for w in wcache_slices):
-                self._wcaches = (
+                wcache = (
                     wcache_slices[0]
                     if len(wcache_slices) == 1
                     else jax.numpy.concatenate(wcache_slices)
                 )
-                # ranks() used by this launch reflect the post-_prepare
-                # registry; key the cache to it.
-                self._wcaches_actors = len(self.actors.actors)
             else:
                 # Cacheless mark-free launch: rows unchanged but slots
                 # re-permuted, so a stale cache must not survive.
-                self._wcaches = None
-        except Exception:
-            self.states = prev_states
-            raise
+                wcache = None
+            return (states, record_chunks, wcache), states.length
+
+        try:
+            new_states, record_chunks, wcache = self._run_launch(attempt)
+        except DeviceLaunchError:
+            if not _degrade_enabled():
+                raise  # committed state untouched: attempts never assign
+            pairs = self._degrade_apply(prep)
+            return {
+                name: [p for _, p in pairs[r]]
+                for r, name in enumerate(self.replica_ids)
+            }
+        self.states = new_states
+        self.stats["launches"] += len(record_chunks)  # successful chunk launches
+        self._wcaches = wcache
+        if wcache is not None:
+            # ranks() used by this launch reflect the post-_prepare
+            # registry; key the cache to it.
+            self._wcaches_actors = len(self.actors.actors)
         self._commit(prep)
         tables = self._batch_mark_op_table()
         out: Dict[str, List[Dict[str, Any]]] = {}

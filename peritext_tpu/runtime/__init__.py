@@ -4,17 +4,31 @@ The host-side control plane of the framework — the equivalents of the
 reference's pubsub.ts / changeQueue.ts / test-merge.ts layer (SURVEY.md §2.4).
 The data plane (batched op application) lives in ``peritext_tpu.ops``.
 """
+from peritext_tpu.runtime import faults
+from peritext_tpu.runtime.faults import FaultError, FaultPlan
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.pubsub import Publisher
 from peritext_tpu.runtime.queue import ChangeQueue
-from peritext_tpu.runtime.sync import apply_changes, causal_order, causal_sort, sync_pair
+from peritext_tpu.runtime.sync import (
+    ConvergenceError,
+    apply_available,
+    apply_changes,
+    causal_order,
+    causal_sort,
+    sync_pair,
+)
 
 __all__ = [
     "ChangeLog",
+    "ConvergenceError",
+    "FaultError",
+    "FaultPlan",
     "Publisher",
     "ChangeQueue",
+    "apply_available",
     "apply_changes",
     "causal_order",
     "causal_sort",
+    "faults",
     "sync_pair",
 ]

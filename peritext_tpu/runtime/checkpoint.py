@@ -15,7 +15,10 @@ Format:
 """
 from __future__ import annotations
 
+import hashlib
+import io
 import json
+import logging
 import os
 from typing import Any, Dict, List, Optional
 
@@ -28,8 +31,11 @@ from peritext_tpu.ops.encode import AttrRegistry
 from peritext_tpu.ops.state import DocState
 from peritext_tpu.ops.universe import TpuUniverse
 from peritext_tpu.oracle.doc import ObjectStore
+from peritext_tpu.runtime import faults
 
 import dataclasses
+
+_log = logging.getLogger(__name__)
 
 _STATE_FIELDS = [f.name for f in dataclasses.fields(DocState)]
 
@@ -42,15 +48,24 @@ CHECKPOINT_FORMAT = 2
 
 
 def save_universe(uni: TpuUniverse, path: str) -> None:
+    # Chaos chokepoint: an injected failure raises before anything is
+    # written; the previous generation stays intact (atomic writes below).
+    faults.fire("checkpoint_write")
     arrays = {f: np.asarray(getattr(uni.states, f)) for f in _STATE_FIELDS}
     # Write both files atomically so a crash mid-save never destroys the
-    # previous good snapshot.
+    # previous good snapshot.  The npz payload is built in memory first so
+    # its digest can ride in the sidecar — restore verifies it and treats a
+    # mismatch (truncation, bit rot) like any other unreadable generation.
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    payload = buf.getvalue()
     tmp_npz = path + ".npz.tmp"
     with open(tmp_npz, "wb") as f:
-        np.savez_compressed(f, **arrays)
+        f.write(payload)
     os.replace(tmp_npz, path + ".npz")
     sidecar = {
         "format": CHECKPOINT_FORMAT,
+        "npz_sha256": hashlib.sha256(payload).hexdigest(),
         "replica_ids": uni.replica_ids,
         "clocks": uni.clocks,
         "lengths": uni.lengths,
@@ -80,6 +95,13 @@ def save_universe(uni: TpuUniverse, path: str) -> None:
     with open(tmp, "w") as f:
         json.dump(sidecar, f)
     os.replace(tmp, path + ".json")
+    # Crash-corruption drill (``checkpoint_write:corrupt=N``): truncate the
+    # just-written npz after the atomic replace, simulating a torn write
+    # that slipped past rename atomicity (e.g. lost page cache on power
+    # failure).  restore_latest must detect it via the digest and fall back.
+    if faults.take("checkpoint_write", "corrupt"):
+        with open(path + ".npz", "r+b") as f:
+            f.truncate(max(1, len(payload) // 2))
 
 
 def _restore_mark_schema(sidecar: Dict[str, Any]) -> None:
@@ -170,7 +192,15 @@ def load_universe(path: str) -> TpuUniverse:
         attrs.intern(attr)
     uni.attrs = attrs
 
-    data = np.load(path + ".npz")
+    with open(path + ".npz", "rb") as f:
+        payload = f.read()
+    expected = sidecar.get("npz_sha256")
+    if expected is not None and hashlib.sha256(payload).hexdigest() != expected:
+        raise ValueError(
+            f"snapshot {path!r}: state payload digest mismatch "
+            "(truncated or corrupt .npz)"
+        )
+    data = np.load(io.BytesIO(payload))
     uni.states = DocState(**{f: jax.numpy.asarray(data[f]) for f in _STATE_FIELDS})
     # Rebuild the allowMultiple group census (gates the cached patch scan)
     # from the restored mark tables.
@@ -248,8 +278,19 @@ class CheckpointManager:
         for generation in reversed(self.generations()):
             try:
                 uni = load_universe(self._path(generation))
-            except (OSError, ValueError, KeyError, zipfile.BadZipFile):
-                continue  # corrupt/partial snapshot: fall back a generation
+            except (OSError, ValueError, KeyError, zipfile.BadZipFile) as exc:
+                # Corrupt/partial snapshot (bad digest, truncated zip,
+                # unreadable sidecar): log it and fall back a generation —
+                # the change log replays the gap, so an older snapshot only
+                # costs replay time, never data.
+                _log.warning(
+                    "checkpoint generation %d unreadable (%s: %s); "
+                    "falling back to the previous generation",
+                    generation,
+                    type(exc).__name__,
+                    exc,
+                )
+                continue
             if log is not None:
                 _replay_tail(uni, log)
             return uni

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Mapping
 
+from peritext_tpu.runtime import faults
+
 Change = Dict[str, Any]
 Clock = Mapping[str, int]
 
@@ -21,6 +23,10 @@ class ChangeLog:
         self._queues: Dict[str, List[Change]] = {}
 
     def append(self, change: Change) -> None:
+        # Durability chokepoint: an injected ``log_append`` failure models a
+        # lost write — it raises *before* any mutation, so the log never
+        # holds a half-recorded change.
+        faults.fire("log_append")
         queue = self._queues.setdefault(change["actor"], [])
         expected = len(queue) + 1
         if change["seq"] != expected:
@@ -36,6 +42,7 @@ class ChangeLog:
         a mismatch means a forked actor history or a corrupted log, which
         must surface rather than silently drop.
         """
+        faults.fire("log_append")
         if change["seq"] < 1:
             # Validate before touching the log: a rejected record must not
             # create a phantom actor entry in clock()/missing_changes.

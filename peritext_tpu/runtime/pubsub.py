@@ -2,11 +2,20 @@
 
 Reference: /root/reference/src/pubsub.ts:1-26 (Publisher).  ``publish`` fans an
 update out to every subscriber except the sender.
+
+Delivery runs through the ``pubsub_deliver`` fault site (runtime/faults.py):
+an active chaos plan can drop, duplicate, delay (wedge) or fail deliveries
+per subscriber, and hold messages back for reordering — held messages
+re-emerge ahead of later publishes to the same subscriber, so causal-gap
+recovery (anti-entropy sync) is what restores convergence, exactly the
+adversarial delivery model the CRDT claims to tolerate.
 """
 from __future__ import annotations
 
 import threading
 from typing import Callable, Dict, Generic, TypeVar
+
+from peritext_tpu.runtime import faults
 
 T = TypeVar("T")
 
@@ -35,4 +44,9 @@ class Publisher(Generic[T]):
         for key, callback in list(self._subscribers.items()):
             if key == sender:
                 continue
-            callback(update)
+            # Per-subscriber stream: drop/dup/reorder decisions (and the
+            # holdback buffer) are independent per receiver, like real
+            # per-link network chaos.
+            for delivered in faults.filter_stream("pubsub_deliver", [update], stream=key):
+                faults.fire("pubsub_deliver")
+                callback(delivered)

@@ -7,11 +7,24 @@ replication.  The reference flushes on a 10ms browser timer (tunable to
 simulate latency); here the timer is an optional daemon thread, and manual
 ``flush()`` covers the demo-style "manual sync button" mode
 (reference index.ts:119-128).
+
+Robustness contract: a flush whose handler raises (or is failed by the
+``queue_flush`` fault site) re-enqueues the popped batch at the *front*, so
+no change is ever lost and a later flush republishes in original order.  The
+timer lifecycle is epoch-guarded: ``drop()`` during an in-flight tick cannot
+race a subsequent ``start()`` into leaking a second timer chain.
 """
 from __future__ import annotations
 
+import itertools
+import logging
 import threading
 from typing import Any, Callable, List, Optional
+
+from peritext_tpu.runtime import faults
+
+_log = logging.getLogger(__name__)
+_queue_ids = itertools.count()
 
 
 class ChangeQueue:
@@ -20,11 +33,23 @@ class ChangeQueue:
         handle_flush: Callable[[List[Any]], None],
         interval: float = 0.01,
         flush_lock: Optional["threading.RLock"] = None,
+        name: Optional[str] = None,
     ) -> None:
+        # Chaos stream key: each queue gets its own drop/dup/reorder stream
+        # (and holdback buffer) so one queue's held-back changes can never
+        # surface through another queue's handler.  Deterministic as long as
+        # queue construction order is (pass ``name`` to pin it exactly).
+        self._name = name if name is not None else f"queue-{next(_queue_ids)}"
         self._changes: List[Any] = []
         self._handle_flush = handle_flush
         self._interval = interval
         self._timer: Optional[threading.Timer] = None
+        # Timer-chain epoch: every start()/drop() bumps it, and a tick only
+        # re-arms if its epoch is still current.  Without this, a drop()
+        # racing an in-flight tick followed by a fresh start() could leave
+        # BOTH the new chain's timer and the old tick's re-arm alive — two
+        # timer chains flushing forever.
+        self._epoch = 0
         self._lock = threading.Lock()
         # Held across pop+handle so two concurrent flushes (timer thread vs
         # a manual sync) cannot publish one actor's changes out of seq
@@ -40,28 +65,60 @@ class ChangeQueue:
         with self._flush_lock:
             with self._lock:
                 changes, self._changes = self._changes, []
-            self._handle_flush(changes)
+            try:
+                if changes:
+                    # Chaos plane: fail/wedge the flush.  Only fired for
+                    # non-empty batches so counted schedules aren't consumed
+                    # by idle timer ticks.
+                    faults.fire("queue_flush")
+                # drop/dup/reorder the batch.  Runs for EMPTY batches too:
+                # a held-back (reordered) change must be able to re-emerge
+                # on a later idle tick, not stay stranded once the editor
+                # goes quiet.
+                changes = faults.filter_stream(
+                    "queue_flush", changes, stream=self._name
+                )
+                self._handle_flush(changes)
+            except BaseException:
+                # A failed flush must not lose the batch: put the surviving
+                # changes back at the front so a later flush retries them
+                # ahead of anything enqueued meanwhile.
+                with self._lock:
+                    self._changes[:0] = changes
+                raise
 
-    def _tick(self) -> None:
-        self.flush()
-        with self._lock:
-            if self._timer is not None:
-                self._timer = threading.Timer(self._interval, self._tick)
-                self._timer.daemon = True
-                self._timer.start()
+    def _tick(self, epoch: int) -> None:
+        try:
+            self.flush()
+        except Exception:
+            # A failing flush (handler error, injected fault) must not kill
+            # the timer chain: the batch was re-enqueued by flush(), so the
+            # next tick retries it.  Log it — the timer thread has no caller
+            # to propagate to.
+            _log.warning("change-queue flush failed; will retry", exc_info=True)
+        finally:
+            with self._lock:
+                if self._timer is not None and epoch == self._epoch:
+                    self._arm_locked()
+
+    def _arm_locked(self) -> None:
+        timer = threading.Timer(self._interval, self._tick, args=(self._epoch,))
+        timer.daemon = True
+        self._timer = timer
+        timer.start()
 
     def start(self) -> None:
         with self._lock:
             if self._timer is not None:
-                return
-            self._timer = threading.Timer(self._interval, self._tick)
-            self._timer.daemon = True
-            self._timer.start()
+                return  # already running: never arm a second chain
+            self._epoch += 1
+            self._arm_locked()
 
     def drop(self) -> None:
         """Stop the timer (go manual-sync).  Reference changeQueue.ts:47-51."""
         with self._lock:
             timer, self._timer = self._timer, None
+            self._epoch += 1  # invalidate any in-flight tick's re-arm
         if timer is not None:
             timer.cancel()
 

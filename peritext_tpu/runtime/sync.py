@@ -15,25 +15,85 @@ from typing import Any, Dict, List, Sequence
 Change = Dict[str, Any]
 
 
-def apply_changes(doc: Any, changes: Sequence[Change]) -> List[Dict[str, Any]]:
-    """Apply changes tolerating causal gaps, retrying until convergence.
+class ConvergenceError(RuntimeError):
+    """``apply_changes`` could not drain its queue: some changes' causal
+    dependencies never arrived (or duplicates/forks kept being rejected).
 
-    Reference test/merge.ts:4-23: unready changes rotate to the back of the
-    queue; a 10k-iteration guard detects divergence (e.g. genuinely missing
-    dependencies).
+    Carries the still-pending changes (``pending``) and their ``(actor,
+    seq)`` ids (``pending_ids``) so chaos-test triage can see exactly which
+    deliveries went missing instead of a bare "did not converge".
+    """
+
+    def __init__(self, pending: Sequence[Change]):
+        self.pending = list(pending)
+        self.pending_ids = [(c["actor"], c["seq"]) for c in self.pending]
+        ids = ", ".join(f"{a}@{s}" for a, s in self.pending_ids[:8])
+        if len(self.pending_ids) > 8:
+            ids += f", ... ({len(self.pending_ids) - 8} more)"
+        super().__init__(
+            f"apply_changes did not converge; {len(self.pending)} change(s) "
+            f"still pending: [{ids}]"
+        )
+
+
+def apply_available(
+    doc: Any, changes: Sequence[Change]
+) -> tuple[List[Dict[str, Any]], List[Change]]:
+    """Apply every causally-ready change; return (patches, still_pending).
+
+    The retry-queue core shared by :func:`apply_changes` and gap-tolerant
+    consumers (the Editor's delivery buffer, chaos fuzzing): unready changes
+    rotate to the back (reference test/merge.ts:4-23) until a full rotation
+    makes no progress, and the unapplied remainder comes back to the caller
+    instead of raising.  Already-seen changes (duplicated deliveries) drop
+    idempotently — the same rule as the batched engine's causal gate — so a
+    retry buffer fed duplicates cannot grow without bound.
     """
     pending = deque(changes)
     patches: List[Dict[str, Any]] = []
-    iterations = 0
+    stuck = 0
     while pending:
         change = pending.popleft()
+        if change["seq"] <= doc.clock.get(change["actor"], 0):
+            continue  # duplicate delivery: already applied
         try:
             patches.extend(doc.apply_change(change))
+            stuck = 0
         except ValueError:
             pending.append(change)
-        iterations += 1
-        if iterations > 10000:
-            raise RuntimeError("apply_changes did not converge")
+            stuck += 1
+            if stuck >= len(pending):
+                break
+        except Exception as exc:
+            # Non-causal failure mid-batch (backend error, malformed
+            # change): earlier changes DID apply and their patches must not
+            # be lost, but a function cannot both return and raise — tag the
+            # exception with the partial progress so consumers with retry
+            # buffers (the Editor) can keep it, and put the failing change
+            # back at the front for redelivery-free retry.
+            pending.appendleft(change)
+            exc.applied_patches = patches  # type: ignore[attr-defined]
+            exc.unapplied = list(pending)  # type: ignore[attr-defined]
+            raise
+    return patches, list(pending)
+
+
+def apply_changes(
+    doc: Any, changes: Sequence[Change], allow_gaps: bool = False
+) -> List[Dict[str, Any]]:
+    """Apply changes tolerating causal gaps, retrying until convergence.
+
+    Reference test/merge.ts:4-23: unready changes rotate to the back of the
+    queue.  Divergence (a full rotation with no progress — genuinely
+    missing dependencies) raises :class:`ConvergenceError` carrying the
+    still-pending changes.  With ``allow_gaps`` (chaotic-delivery mode:
+    drops/dups/reorders are expected and a later anti-entropy sync
+    redelivers), the undeliverable remainder is silently left unapplied
+    instead.
+    """
+    patches, pending = apply_available(doc, changes)
+    if pending and not allow_gaps:
+        raise ConvergenceError(pending)
     return patches
 
 

@@ -89,6 +89,33 @@ def test_checkpoint_manager_skips_corrupt_generation(tmp_path):
     assert restored.spans("doc1") == good_spans
 
 
+def test_snapshot_digest_detects_truncation(tmp_path, caplog):
+    """A truncated/corrupt npz is caught by the sidecar digest (not just by
+    zip parsing luck), and restore_latest logs the fallback instead of
+    crashing."""
+    import logging
+
+    import pytest
+
+    from peritext_tpu.runtime.checkpoint import CheckpointManager
+
+    _, log, uni = build_session(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    mgr.save(uni)
+    good_spans = uni.spans("doc1")
+    path = mgr.save(uni)
+    with open(path + ".npz", "r+b") as f:
+        size = f.seek(0, 2)
+        f.truncate(size // 2)  # torn write: half the payload survives
+    with pytest.raises(ValueError, match="digest mismatch"):
+        load_universe(path)
+    with caplog.at_level(logging.WARNING, logger="peritext_tpu.runtime.checkpoint"):
+        restored = mgr.restore_latest()
+    assert restored is not None
+    assert restored.spans("doc1") == good_spans
+    assert any("falling back" in r.message for r in caplog.records)
+
+
 def test_log_only_cold_rebuild_matches_snapshot(tmp_path):
     """The log alone reconstructs the same state as snapshot+tail (the
     reference durability model: state == replayed change log)."""

@@ -1,0 +1,848 @@
+"""Chaos differential suite: fault injection, retry/backoff, degradation.
+
+Exercises the invariants the rest of the repo only asserts in comments:
+
+- control-plane commits happen only after a successful device launch
+  (rollback is observable when launches keep failing),
+- transient launch failures are absorbed by the retry policy,
+- on retry exhaustion ingest completes on the oracle CPU path with
+  byte-identical patches/state vs a fault-free control universe,
+- delivery-level chaos (drop/dup/reorder) cannot break convergence once
+  anti-entropy quiesces the fleet,
+- a mid-ingest crash restores exactly via checkpoint + log-tail replay.
+
+Everything runs on seeded :class:`FaultPlan` schedules, so each test injects
+the exact same faults on every run.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from peritext_tpu.fuzz import DEFAULT_CHAOS_SPEC, fuzz
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.ops.doc import TpuDoc
+from peritext_tpu.ops.universe import DeviceLaunchError
+from peritext_tpu.oracle import Doc
+from peritext_tpu.runtime import ChangeLog, ChangeQueue, Publisher, apply_changes, faults
+from peritext_tpu.runtime.faults import FaultError, FaultPlan
+from peritext_tpu.testing import generate_docs
+
+STATE_FIELDS = (
+    "elem_ctr", "elem_act", "deleted", "chars", "bnd_def", "bnd_mask",
+    "mark_ctr", "mark_act", "mark_action", "mark_type", "mark_attr",
+    "length", "mark_count",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane(monkeypatch):
+    """Every test starts and ends with no process-wide plan, no resilience
+    env overrides, and fast backoff."""
+    faults.reset()
+    monkeypatch.delenv("PERITEXT_FAULTS", raising=False)
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    yield
+    faults.reset()
+
+
+def snapshot_control_plane(uni):
+    return (
+        [dict(c) for c in uni.clocks],
+        list(uni.lengths),
+        list(uni.mark_counts),
+        [json.dumps(s.to_json(), sort_keys=True) for s in uni.stores],
+        list(uni.text_objs),
+    )
+
+
+def device_plane(uni):
+    return {f: np.asarray(getattr(uni.states, f)).copy() for f in STATE_FIELDS}
+
+
+def assert_device_planes_equal(a, b):
+    for f in STATE_FIELDS:
+        assert (a[f] == b[f]).all(), f"device plane differs at {f}"
+
+
+# ---------------------------------------------------------------------------
+# The fault plane itself
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_spec_parsing():
+    plan = FaultPlan.from_spec(
+        "seed=9;device_launch:fail=2,wedge=0.5x3;pubsub_deliver:drop=0.3,dup=0.1,"
+        "reorder=0.2;checkpoint_write:corrupt=1"
+    )
+    assert plan.seed == 9
+    launch = plan.site("device_launch")
+    assert launch.fail == 2 and launch.wedge == 3 and launch.wedge_seconds == 0.5
+    deliver = plan.site("pubsub_deliver")
+    assert (deliver.drop, deliver.dup, deliver.reorder) == (0.3, 0.1, 0.2)
+    assert plan.site("checkpoint_write").corrupt == 1
+    with pytest.raises(ValueError, match="bad fault clause"):
+        FaultPlan.from_spec("device_launch")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultPlan.from_spec("device_launch:explode=1")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.from_spec("device_lauch:fail=1")  # typo'd site: fail loudly
+
+
+def test_fail_schedule_counts_down_and_stats():
+    plan = FaultPlan.from_spec("log_append:fail=2")
+    for _ in range(2):
+        with pytest.raises(FaultError):
+            plan.fire("log_append")
+    plan.fire("log_append")  # budget consumed: back to no-op
+    assert plan.stats["log_append"]["fired"] == 3
+    assert plan.stats["log_append"]["failed"] == 2
+
+
+def test_filter_stream_is_deterministic_and_reorders_across_calls():
+    def run():
+        plan = FaultPlan.from_spec("seed=5;pubsub_deliver:drop=0.3,dup=0.2,reorder=0.4")
+        seen = []
+        for batch in ([1, 2, 3], [4, 5], [6, 7, 8, 9], [], [10]):
+            seen.append(plan.filter_stream("pubsub_deliver", batch, stream="r1"))
+        seen.append(plan.drain("pubsub_deliver", stream="r1"))
+        return seen, plan.stats["pubsub_deliver"]
+
+    first, stats = run()
+    second, _ = run()
+    assert first == second  # same seed, same call sequence => same chaos
+    flat = [x for batch in first for x in batch]
+    # Dropped messages are gone; everything else (incl. held-back reorders
+    # released by drain) eventually surfaced.
+    assert stats["dropped"] == 10 - len(set(flat))
+    assert stats["duplicated"] == len(flat) - len(set(flat))
+
+
+def test_wedge_sleeps():
+    plan = faults.install("device_readback:wedge=0.05x1")
+    t0 = time.monotonic()
+    plan.fire("device_readback")
+    assert time.monotonic() - t0 >= 0.04
+    t0 = time.monotonic()
+    plan.fire("device_readback")  # count consumed
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_env_spec_activates_and_reparses(monkeypatch):
+    monkeypatch.setenv("PERITEXT_FAULTS", "log_append:fail=1")
+    faults.reset()
+    log = ChangeLog()
+    with pytest.raises(FaultError):
+        log.record({"actor": "a", "seq": 1, "deps": {}, "startOp": 1, "ops": []})
+    assert log.clock() == {}  # injected failure lost nothing half-written
+    log.record({"actor": "a", "seq": 1, "deps": {}, "startOp": 1, "ops": []})
+    assert log.clock() == {"a": 1}
+
+
+# ---------------------------------------------------------------------------
+# Delivery chaos: pubsub + queue
+# ---------------------------------------------------------------------------
+
+
+def test_pubsub_chaos_converges_after_quiesce():
+    """Drop/dup/reorder every delivery; anti-entropy from the durable log
+    must still converge the fleet byte-identically."""
+    docs, _, genesis = generate_docs("pubsub chaos", count=3)
+    log = ChangeLog()
+    log.record(genesis)
+    pub = Publisher()
+    for doc in docs:
+        pub.subscribe(
+            doc.actor_id,
+            lambda changes, doc=doc: apply_changes(doc, list(changes), allow_gaps=True),
+        )
+    plan = faults.install("seed=3;pubsub_deliver:drop=0.4,dup=0.3,reorder=0.4")
+    for i in range(12):
+        author = docs[i % 3]
+        c, _ = author.change(
+            [{"path": ["text"], "action": "insert", "index": i, "values": [chr(97 + i)]}]
+        )
+        log.record(c)
+        pub.publish(author.actor_id, [c])
+    stats = plan.stats["pubsub_deliver"]
+    assert stats["dropped"] + stats["duplicated"] + stats["reordered"] > 0
+    # Quiesce: fault-free catch-up from the log.
+    faults.reset()
+    for doc in docs:
+        apply_changes(doc, log.missing_changes(log.clock(), doc.clock))
+    expected = docs[0].get_text_with_formatting(["text"])
+    assert all(d.get_text_with_formatting(["text"]) == expected for d in docs)
+    assert all(d.clock == docs[0].clock for d in docs)
+
+
+def test_queue_flush_failure_requeues_batch():
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.append)
+    queue.enqueue({"seq": 1}, {"seq": 2})
+    faults.install("queue_flush:fail=1")
+    with pytest.raises(FaultError):
+        queue.flush()
+    assert len(queue) == 2  # nothing lost
+    queue.flush()  # budget consumed: delivers, in original order
+    assert flushed == [[{"seq": 1}, {"seq": 2}]]
+
+
+def test_queue_flush_handler_exception_requeues_ahead_of_new_traffic():
+    calls = []
+
+    def handler(changes):
+        calls.append(list(changes))
+        if len(calls) == 1:
+            raise RuntimeError("publish failed")
+
+    queue = ChangeQueue(handle_flush=handler)
+    queue.enqueue("a", "b")
+    with pytest.raises(RuntimeError):
+        queue.flush()
+    queue.enqueue("c")
+    queue.flush()
+    assert calls == [["a", "b"], ["a", "b", "c"]]
+
+
+def test_queue_flush_stream_chaos():
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend)
+    faults.install("seed=1;queue_flush:dup=1.0")
+    queue.enqueue("x")
+    queue.flush()
+    assert flushed == ["x", "x"]
+
+
+def test_queue_holdback_buffers_are_per_queue():
+    """Reordered (held-back) changes must re-emerge from THEIR queue only —
+    one actor's changes must never surface through another actor's flush
+    handler (which would publish them under the wrong sender)."""
+    out_a, out_b = [], []
+    qa = ChangeQueue(handle_flush=out_a.extend, name="actor-a")
+    qb = ChangeQueue(handle_flush=out_b.extend, name="actor-b")
+    faults.install("seed=4;queue_flush:reorder=1.0")
+    for i in range(6):
+        qa.enqueue(("a", i))
+        qa.flush()
+        qb.enqueue(("b", i))
+        qb.flush()
+    faults.reset()
+    qa.flush()
+    qb.flush()
+    assert all(item[0] == "a" for item in out_a)
+    assert all(item[0] == "b" for item in out_b)
+
+
+def test_queue_idle_flush_releases_held_back_changes():
+    """A change held back by the reorder schedule must re-emerge on a later
+    (even empty) flush — the last edit before an editor goes idle can be
+    delayed, never stranded."""
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, name="idle-q")
+    faults.install("seed=2;queue_flush:reorder=1.0")
+    queue.enqueue("last-edit")
+    queue.flush()  # held back
+    for _ in range(20):  # idle ticks: the holdback must drain
+        if "last-edit" in flushed:
+            break
+        queue.flush()
+    assert "last-edit" in flushed
+
+
+def test_editor_delivery_buffer_tolerates_gaps_dups_reorders():
+    """The Editor's receive path keeps a retry buffer: reordered deliveries
+    wait for their dependencies, duplicates drop idempotently, and a gap
+    never turns later publishes into exceptions (which would livelock the
+    sender's flush retry and starve other subscribers)."""
+    from peritext_tpu.bridge import Editor, initialize_docs
+
+    alice_doc, bob_doc = Doc("alice"), Doc("bob")
+    pub = Publisher()
+    alice = Editor(alice_doc, pub)
+    bob = Editor(bob_doc, pub)
+    initialize_docs([alice_doc, bob_doc])
+    alice.insert(0, "hel")
+    alice.insert(3, "lo")
+    c1, c2 = alice.change_log[-2], alice.change_log[-1]
+    # Adversarial delivery straight into the subscriber callback: newest
+    # first (causal gap), then a duplicate, then the missing dependency.
+    bob._receive_changes([c2])
+    assert bob._pending and bob.text() == ""
+    bob._receive_changes([c2])  # duplicate of the still-unready change
+    bob._receive_changes([c1])  # the gap closes: both apply
+    assert bob._pending == []
+    assert bob.text() == alice.text() == "hello"
+    assert bob.spans() == alice.spans()
+
+
+def test_editor_preserves_applied_patches_when_mid_batch_apply_fails():
+    """A non-causal failure in the middle of a delivered batch must not
+    lose the already-applied changes' patches: the doc advanced, redelivery
+    dedupes them, so this was the only chance to surface them."""
+    from peritext_tpu.bridge import Editor, initialize_docs
+
+    class FlakyDoc(Doc):
+        fail_on_seq = None
+
+        def apply_change(self, change):
+            if change["seq"] == self.fail_on_seq:
+                self.fail_on_seq = None  # trip once
+                raise RuntimeError("backend hiccup")
+            return super().apply_change(change)
+
+    alice_doc, bob_doc = Doc("alice"), FlakyDoc("bob")
+    pub = Publisher()
+    alice = Editor(alice_doc, pub)
+    seen = []
+    bob = Editor(bob_doc, pub, on_remote_patch=seen.append)
+    initialize_docs([alice_doc, bob_doc])
+    alice.insert(0, "one")
+    alice.insert(3, "two")
+    c1, c2 = alice.change_log[-2], alice.change_log[-1]
+    bob_doc.fail_on_seq = c2["seq"]
+    with pytest.raises(RuntimeError, match="backend hiccup"):
+        bob._receive_changes([c1, c2])
+    # c1 applied and its patches surfaced; c2 stays buffered.
+    assert any(p.get("values") == ["o"] for p in seen)
+    assert [c["seq"] for c in bob._pending] == [c2["seq"]]
+    bob._receive_changes([])  # retry drains the buffer
+    assert bob._pending == []
+    assert bob.text() == alice.text() == "onetwo"
+
+
+def test_editor_drops_poison_change_instead_of_wedging(caplog):
+    """A change that fails PERMANENTLY (non-transient error) must not sit at
+    the head of the retry buffer forever — that would head-of-line block
+    every later delivery from every peer.  It is dropped and logged;
+    subsequent traffic keeps applying."""
+    import logging
+
+    from peritext_tpu.bridge import Editor, initialize_docs
+
+    class PoisonedDoc(Doc):
+        poison_seq = None
+
+        def apply_change(self, change):
+            if change["seq"] == self.poison_seq:
+                raise KeyError("malformed op: no such object")  # permanent
+            return super().apply_change(change)
+
+    alice_doc, bob_doc = Doc("alice"), PoisonedDoc("bob")
+    pub = Publisher()
+    alice = Editor(alice_doc, pub)
+    bob = Editor(bob_doc, pub)
+    initialize_docs([alice_doc, bob_doc])
+    alice.insert(0, "one")
+    alice.insert(3, "two")
+    c1, c2 = alice.change_log[-2], alice.change_log[-1]
+    bob_doc.poison_seq = c1["seq"]
+    with caplog.at_level(logging.WARNING, logger="peritext_tpu.bridge"):
+        with pytest.raises(KeyError):
+            bob._receive_changes([c1])
+    assert any("dropping permanently-failing change" in r.message for r in caplog.records)
+    # The poison change is gone from the buffer; later traffic still lands
+    # (c2 waits only for its genuine causal gap, not behind the poison).
+    bob._receive_changes([c2])
+    assert [c["seq"] for c in bob._pending] == [c2["seq"]]
+    bob_doc.poison_seq = None
+    bob._receive_changes([c1])  # a clean redelivery closes the gap
+    assert bob._pending == []
+    assert bob.text() == alice.text() == "onetwo"
+
+
+def test_chaos_fuzz_validates_quiesce_and_runs_final_pass():
+    with pytest.raises(ValueError, match="chaos_quiesce"):
+        fuzz(iterations=4, seed=0, chaos=DEFAULT_CHAOS_SPEC, chaos_quiesce=0)
+    # Iterations NOT a multiple of the quiesce interval: the trailing
+    # chaotic iterations are covered by the final quiesce, and the fleet
+    # must end converged.
+    result = fuzz(iterations=13, seed=9, chaos=DEFAULT_CHAOS_SPEC, chaos_quiesce=8)
+    expected = result["docs"][0].get_text_with_formatting(["text"])
+    assert all(
+        d.get_text_with_formatting(["text"]) == expected for d in result["docs"]
+    )
+    assert all(d.clock == result["docs"][0].clock for d in result["docs"])
+
+
+def test_queue_timer_chain_survives_flush_failure():
+    """An exception inside a timer tick's flush must not kill the chain:
+    the tick re-arms and the re-enqueued batch is retried (finding: a dead
+    timer with _timer still set also blocked any restart via start())."""
+    calls = []
+
+    def handler(changes):
+        calls.append(list(changes))
+        if len(calls) == 1:
+            raise RuntimeError("transient publish failure")
+
+    queue = ChangeQueue(handle_flush=handler, interval=60.0)
+    try:
+        queue.enqueue("x")
+        queue.start()
+        first = queue._timer
+        queue._tick(queue._epoch)  # handler raises; chain must survive
+        assert queue._timer is not None and queue._timer is not first
+        assert len(queue) == 1  # batch re-enqueued, not lost
+        queue.flush()
+        assert calls[-1] == ["x"]
+    finally:
+        queue.drop()
+
+
+# ---------------------------------------------------------------------------
+# Resilient device ingest: retry, degradation, rollback
+# ---------------------------------------------------------------------------
+
+
+def build_universe(text="resilient doc", count=2):
+    docs, _, genesis = generate_docs(text, count=count)
+    log = ChangeLog()
+    log.record(genesis)
+    uni = TpuUniverse([d.actor_id for d in docs])
+    uni.apply_changes({d.actor_id: [genesis] for d in docs})
+    return docs, log, uni
+
+
+MIXED_OPS = [
+    {"path": ["text"], "action": "insert", "index": 4, "values": list("+++")},
+    {"path": ["text"], "action": "delete", "index": 1, "count": 2},
+    {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 7,
+     "markType": "comment", "attrs": {"id": "c-1"}},
+    {"path": ["text"], "action": "addMark", "startIndex": 3, "endIndex": 9,
+     "markType": "link", "attrs": {"url": "a.com"}},
+    {"path": ["text"], "action": "removeMark", "startIndex": 5, "endIndex": 8,
+     "markType": "strong"},
+    {"path": [], "action": "makeMap", "key": "meta"},
+    {"path": ["meta"], "action": "set", "key": "k", "value": 7},
+]
+
+
+def test_launch_retry_absorbs_transient_failures(monkeypatch):
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "3")
+    docs, _, uni = build_universe()
+    c, _ = docs[0].change(MIXED_OPS)
+    docs[1].apply_change(c)
+    plan = faults.install("device_launch:fail=2")
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni.stats["launch_retries"] == 2
+    assert uni.stats["degraded_batches"] == 0
+    assert plan.stats["device_launch"]["failed"] == 2
+    assert uni.spans("doc1") == docs[0].get_text_with_formatting(["text"])
+
+
+def test_retry_exhaustion_degrades_to_oracle_byte_identically(monkeypatch):
+    """The acceptance scenario: >= 2 consecutive launch failures exhaust the
+    budget, ingest completes on the oracle path, and patches + device plane
+    + host stores are byte-identical to a fault-free control universe."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    docs, _, uni = build_universe()
+    ctrl = TpuUniverse(["doc1", "doc2"])
+    _, _, genesis = generate_docs("resilient doc", count=2)
+    ctrl.apply_changes({"doc1": [genesis], "doc2": [genesis]})
+
+    c, _ = docs[0].change(MIXED_OPS)
+    oracle_patches = docs[1].apply_change(c)
+    faults.install("device_launch:fail=99")  # persistent: budget exhausts
+    degraded = uni.apply_changes_with_patches({"doc1": [c], "doc2": [c]})
+    assert uni.stats["degraded_batches"] == 1
+    faults.reset()
+    control = ctrl.apply_changes_with_patches({"doc1": [c], "doc2": [c]})
+
+    assert degraded["doc2"] == oracle_patches  # byte-identical patch stream
+    assert degraded["doc1"] == control["doc1"]
+    assert_device_planes_equal(device_plane(uni), device_plane(ctrl))
+    assert snapshot_control_plane(uni)[:3] == snapshot_control_plane(ctrl)[:3]
+    for s_a, s_b in zip(snapshot_control_plane(uni)[3], snapshot_control_plane(ctrl)[3]):
+        assert s_a == s_b  # degraded staging == host-op staging
+    assert (uni.digests() == ctrl.digests()).all()
+
+    # The degraded device plane keeps serving the kernels: a later
+    # fault-free ingest through the sorted merge must still agree.
+    c2, _ = docs[1].change(
+        [{"path": ["text"], "action": "insert", "index": 2, "values": list("zz")},
+         {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 6,
+          "markType": "em"}]
+    )
+    docs[0].apply_change(c2)
+    uni.apply_changes({"doc1": [c2], "doc2": [c2]})
+    assert uni.spans("doc1") == docs[0].get_text_with_formatting(["text"])
+    assert (uni.digests() == uni.digests()[0]).all()
+
+
+def test_degradation_handles_genesis_batch(monkeypatch):
+    """Launch failure on the very first batch (makeList + inserts): the
+    degraded path must create the device binding itself."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    docs, _, genesis = generate_docs("genesis under fire", count=2)
+    uni = TpuUniverse(["doc1", "doc2"])
+    faults.install("device_launch:fail=99")
+    uni.apply_changes({"doc1": [genesis], "doc2": [genesis]})
+    assert uni.stats["degraded_batches"] == 1
+    faults.reset()
+    assert uni.text("doc1") == "genesis under fire"
+    assert uni.text_objs[0] is not None
+    c, _ = docs[0].change([{"path": ["text"], "action": "delete", "index": 0, "count": 8}])
+    docs[1].apply_change(c)
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni.text("doc1") == "".join(docs[0].root["text"])
+
+
+def test_degradation_under_scan_patch_path(monkeypatch):
+    """PERITEXT_PATCH_PATH=scan (the interleaved fallback CI also runs):
+    degrade from that launch path too, byte-identical to its control."""
+    monkeypatch.setenv("PERITEXT_PATCH_PATH", "scan")
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    docs, _, uni = build_universe()
+    ctrl = TpuUniverse(["doc1", "doc2"])
+    _, _, genesis = generate_docs("resilient doc", count=2)
+    ctrl.apply_changes_with_patches({"doc1": [genesis], "doc2": [genesis]})
+    c, _ = docs[0].change(MIXED_OPS)
+    docs[1].apply_change(c)
+    faults.install("device_launch:fail=99")
+    degraded = uni.apply_changes_with_patches({"doc1": [c], "doc2": [c]})
+    faults.reset()
+    control = ctrl.apply_changes_with_patches({"doc1": [c], "doc2": [c]})
+    assert uni.stats["degraded_batches"] == 1
+    assert degraded == control
+    assert_device_planes_equal(device_plane(uni), device_plane(ctrl))
+
+
+def test_degradation_of_concurrent_multi_actor_batch(monkeypatch):
+    """Concurrent inserts/marks from three actors land as ONE degraded
+    batch: the skip-past-greater-ids placement rule and mark-table append
+    order must survive the oracle round trip (digests equal a fault-free
+    control, spans equal the fully-synced oracle docs)."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    docs, _, genesis = generate_docs("concurrent base", count=3)
+    names = [d.actor_id for d in docs]
+    uni = TpuUniverse(names)
+    ctrl = TpuUniverse(names)
+    for u in (uni, ctrl):
+        u.apply_changes({n: [genesis] for n in names})
+    # Three concurrent changes at overlapping positions, unsynced authors.
+    concurrent = []
+    for i, doc in enumerate(docs):
+        c, _ = doc.change(
+            [{"path": ["text"], "action": "insert", "index": 4, "values": list(f"<{i}>")},
+             {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 8,
+              "markType": ["strong", "em", "comment"][i],
+              **({"attrs": {"id": f"cc-{i}"}} if i == 2 else {})}]
+        )
+        concurrent.append(c)
+    for i, doc in enumerate(docs):  # full oracle cross-sync
+        for j, c in enumerate(concurrent):
+            if j != i:
+                doc.apply_change(c)
+    batch = {n: list(concurrent) for n in names}
+    faults.install("device_launch:fail=99")
+    uni.apply_changes(batch)
+    faults.reset()
+    ctrl.apply_changes(batch)
+    assert uni.stats["degraded_batches"] == 1
+    assert_device_planes_equal(device_plane(uni), device_plane(ctrl))
+    expected = docs[0].get_text_with_formatting(["text"])
+    assert all(docs[i].get_text_with_formatting(["text"]) == expected for i in range(3))
+    for n in names:
+        assert uni.spans(n) == expected
+    assert (uni.digests() == ctrl.digests()).all()
+
+
+def test_rollback_without_degradation(monkeypatch):
+    """PERITEXT_DEGRADE=0: exhaustion raises DeviceLaunchError and the
+    committed state — clocks, lengths, stores, device plane — is untouched
+    (the atomicity invariant, now exercised rather than asserted)."""
+    monkeypatch.setenv("PERITEXT_DEGRADE", "0")
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    docs, _, uni = build_universe()
+    before_cp = snapshot_control_plane(uni)
+    before_dev = device_plane(uni)
+    c, _ = docs[0].change(MIXED_OPS)
+    docs[1].apply_change(c)
+    faults.install("device_launch:fail=99")
+    with pytest.raises(DeviceLaunchError) as excinfo:
+        uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert excinfo.value.attempts == 2
+    assert isinstance(excinfo.value.cause, FaultError)
+    assert snapshot_control_plane(uni) == before_cp
+    assert_device_planes_equal(device_plane(uni), before_dev)
+    # Clearing the faults, the same batch applies cleanly: nothing was
+    # half-staged.
+    faults.reset()
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni.spans("doc1") == docs[0].get_text_with_formatting(["text"])
+
+
+def test_strict_commit_barrier_precedes_control_plane_commit(monkeypatch):
+    """PERITEXT_STRICT_COMMIT=1: the execution barrier (a device_readback)
+    runs before any control-plane commit — an injected readback failure
+    must leave clocks/lengths/roots and the device plane unchanged."""
+    monkeypatch.setenv("PERITEXT_STRICT_COMMIT", "1")
+    monkeypatch.setenv("PERITEXT_DEGRADE", "0")
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    docs, _, uni = build_universe()
+    before_cp = snapshot_control_plane(uni)
+    before_dev = device_plane(uni)
+    c, _ = docs[0].change(MIXED_OPS)
+    docs[1].apply_change(c)
+    plan = faults.install("device_readback:fail=1")
+    with pytest.raises(DeviceLaunchError) as excinfo:
+        uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert isinstance(excinfo.value.cause, FaultError)
+    assert plan.stats["device_readback"]["failed"] == 1
+    assert snapshot_control_plane(uni) == before_cp
+    assert_device_planes_equal(device_plane(uni), before_dev)
+    # The barrier budget consumed, the same ingest commits cleanly.
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni.clock("doc1")["doc1"] == c["seq"]
+    assert uni.spans("doc1") == docs[0].get_text_with_formatting(["text"])
+
+
+def test_per_attempt_deadline_retries_wedged_readback(monkeypatch):
+    """A wedged readback (the relay failure mode) trips the wall-clock
+    deadline; the retry then succeeds once the wedge budget is consumed."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_TIMEOUT", "0.05")
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "2")
+    docs, _, uni = build_universe()
+    c, _ = docs[0].change([{"path": ["text"], "action": "insert", "index": 0, "values": ["w"]}])
+    docs[1].apply_change(c)
+    faults.install("device_readback:wedge=0.2x1")
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni.stats["launch_retries"] >= 1
+    assert uni.spans("doc1") == docs[0].get_text_with_formatting(["text"])
+
+
+def test_tpu_doc_ingest_rides_the_resilience_policy(monkeypatch):
+    """TpuDoc.apply_change routes through the universe ingest path, so a
+    persistent launch failure degrades and the doc still converges with the
+    oracle — the single-replica acceptance path."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    oracle = Doc("doc1")
+    genesis, _ = oracle.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0, "values": list("tpu doc state")}]
+    )
+    c2, _ = oracle.change(MIXED_OPS)
+    tdoc = TpuDoc("mirror")
+    p1 = tdoc.apply_change(genesis)
+    faults.install("device_launch:fail=99")
+    p2 = tdoc.apply_change(c2)
+    faults.reset()
+    assert tdoc._uni.stats["degraded_batches"] >= 1
+    assert tdoc.get_text_with_formatting(["text"]) == oracle.get_text_with_formatting(["text"])
+    # Patch streams accumulated across the degraded ingest equal the
+    # oracle's replayed stream.
+    fresh = Doc("fresh")
+    expected = fresh.apply_change(genesis) + fresh.apply_change(c2)
+    assert p1 + p2 == expected
+
+
+def test_local_change_rolls_back_cleanly_on_launch_exhaustion(monkeypatch):
+    """Local generation (TpuDoc.change) commits seq/clock/lengths before the
+    launch; retry exhaustion must restore ALL of it — otherwise the actor's
+    stream is permanently wedged (peers reject every later seq).  Host-op
+    store mutations (makeMap) roll back too."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    tdoc = TpuDoc("author")
+    genesis, _ = tdoc.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0, "values": list("base")}]
+    )
+    before = (tdoc.seq, tdoc.max_op, dict(tdoc.clock), tdoc._uni.lengths[0],
+              {k: set(v) for k, v in tdoc._uni._multi_groups.items()})
+    faults.install("device_launch:fail=99")
+    with pytest.raises(DeviceLaunchError):
+        tdoc.change(
+            [{"path": [], "action": "makeMap", "key": "meta"},
+             {"path": ["text"], "action": "insert", "index": 4, "values": ["!"]},
+             {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 2,
+              "markType": "comment", "attrs": {"id": "rb"}}]
+        )
+    faults.reset()
+    assert (tdoc.seq, tdoc.max_op, dict(tdoc.clock), tdoc._uni.lengths[0],
+            tdoc._uni._multi_groups) == before
+    assert "meta" not in tdoc.root  # host-op staging rolled back too
+    # The stream is NOT wedged: the next change takes the same seq the
+    # failed one would have, and a peer accepts the log without gaps.
+    c, _ = tdoc.change([{"path": ["text"], "action": "insert", "index": 4, "values": ["?"]}])
+    assert c["seq"] == genesis["seq"] + 1
+    peer = Doc("peer")
+    peer.apply_change(genesis)
+    peer.apply_change(c)
+    assert "".join(peer.root["text"]) == tdoc._uni.text(0)
+    assert tdoc.get_text_with_formatting(["text"]) == peer.get_text_with_formatting(["text"])
+
+
+def test_local_change_rollback_restores_capacity(monkeypatch):
+    """A failing change that triggered _ensure_capacity growth must roll the
+    capacities back WITH the states — otherwise the next resize is skipped
+    and kernels scatter past the restored arrays' bounds."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    tdoc = TpuDoc("author", capacity=32, max_mark_ops=32)
+    genesis, _ = tdoc.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0, "values": list("x" * 20)}]
+    )
+    assert tdoc._uni.capacity == 32
+    faults.install("device_launch:fail=99")
+    with pytest.raises(DeviceLaunchError):
+        # 30 inserts push past capacity: growth happens, then the launch dies.
+        tdoc.change([{"path": ["text"], "action": "insert", "index": 0, "values": list("y" * 30)}])
+    faults.reset()
+    uni = tdoc._uni
+    assert uni.capacity == 32 and uni.states.capacity == 32
+    # A later growth-requiring change must resize for real and stay correct.
+    c, _ = tdoc.change([{"path": ["text"], "action": "insert", "index": 0, "values": list("z" * 40)}])
+    assert uni.capacity >= 60 and uni.states.capacity == uni.capacity
+    peer = Doc("peer")
+    peer.apply_change(genesis)
+    peer.apply_change(c)
+    assert tdoc.get_text_with_formatting(["text"]) == peer.get_text_with_formatting(["text"])
+
+
+# ---------------------------------------------------------------------------
+# Crash/recovery: checkpoint + log replay
+# ---------------------------------------------------------------------------
+
+
+def test_kill_during_ingest_restores_exact_pre_crash_state(tmp_path, monkeypatch):
+    """The acceptance crash drill: snapshot, more committed work, then a
+    'kill' mid-ingest (launch failure with degradation off).  A fresh
+    process restores via restore_latest + log tail replay to the exact
+    pre-crash state — the in-flight batch is not in the log, so it is
+    cleanly absent; redelivering it converges."""
+    from peritext_tpu.runtime.checkpoint import CheckpointManager
+
+    monkeypatch.setenv("PERITEXT_DEGRADE", "0")
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    docs, log, uni = build_universe("crash drill")
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), interval=1, keep=2)
+    mgr.save(uni)
+
+    # Committed work after the snapshot (in the log: replays on restore).
+    c1, _ = docs[0].change([{"path": ["text"], "action": "insert", "index": 0, "values": list("ok ")}])
+    log.record(c1)
+    docs[1].apply_change(c1)
+    uni.apply_changes({"doc1": [c1], "doc2": [c1]})
+    pre_crash_dev = device_plane(uni)
+    pre_crash_cp = snapshot_control_plane(uni)
+
+    # The doomed in-flight batch: logged by the author, never committed.
+    c2, _ = docs[0].change([{"path": ["text"], "action": "delete", "index": 0, "count": 3}])
+    log.record(c2)
+    docs[1].apply_change(c2)
+    faults.install("device_launch:fail=99")
+    with pytest.raises(DeviceLaunchError):
+        uni.apply_changes({"doc1": [c2], "doc2": [c2]})
+    faults.reset()
+
+    # 'Process restart': replay only through c1 (the pre-crash frontier).
+    tail = ChangeLog()
+    for change in log.all_changes():
+        if not (change["actor"] == "doc1" and change["seq"] == c2["seq"]):
+            tail.record(change)
+    restored = mgr.restore_latest(tail)
+    assert restored is not None
+    assert_device_planes_equal(device_plane(restored), pre_crash_dev)
+    assert snapshot_control_plane(restored) == pre_crash_cp
+
+    # Redelivering the full log (incl. the batch that was in flight at the
+    # crash) converges with the surviving oracle replicas.
+    restored2 = mgr.restore_latest(log)
+    for name, doc in (("doc1", docs[0]), ("doc2", docs[1])):
+        assert restored2.spans(name) == doc.get_text_with_formatting(["text"])
+
+
+def test_checkpoint_corrupt_write_falls_back_and_logs(tmp_path, caplog):
+    import logging
+
+    from peritext_tpu.runtime.checkpoint import CheckpointManager
+
+    docs, log, uni = build_universe("corrupt ckpt")
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    mgr.save(uni)
+    good = uni.spans("doc1")
+    faults.install("checkpoint_write:corrupt=1")
+    mgr.save(uni)  # newest generation written then truncated (torn write)
+    faults.reset()
+    with caplog.at_level(logging.WARNING, logger="peritext_tpu.runtime.checkpoint"):
+        restored = mgr.restore_latest()
+    assert restored is not None
+    assert restored.spans("doc1") == good
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_checkpoint_write_fault_preserves_previous_generation(tmp_path):
+    from peritext_tpu.runtime.checkpoint import CheckpointManager
+
+    docs, log, uni = build_universe("write fault")
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    mgr.save(uni)
+    gens = mgr.generations()
+    faults.install("checkpoint_write:fail=1")
+    with pytest.raises(FaultError):
+        mgr.save(uni)
+    faults.reset()
+    assert mgr.generations() == gens  # nothing new, nothing destroyed
+    assert mgr.restore_latest() is not None
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos matrix (tier-1) + soak (PERITEXT_SLOW)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_fuzz_matrix_oracle(seed):
+    fuzz(iterations=48, seed=seed, chaos=DEFAULT_CHAOS_SPEC, chaos_quiesce=6)
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_nested_objects():
+    fuzz(iterations=32, seed=5, chaos=DEFAULT_CHAOS_SPEC, nested=True)
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_tpu_engine_with_launch_faults(monkeypatch):
+    """The payoff differential: mixed oracle/TPU replicas under chaotic
+    delivery WHILE an installed plan fails device launches — the retry
+    policy must absorb every transient failure (local generation retries
+    but does not degrade, so the budget covers the worst-case streak) and
+    every quiesce still demands byte-identical convergence."""
+    import itertools
+
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "2")
+    flip = itertools.cycle([TpuDoc, Doc])
+
+    def factory(actor_id):
+        return next(flip)(actor_id)
+
+    plan = faults.install("seed=2;device_launch:fail=6")
+    fuzz(
+        iterations=24,
+        seed=6,
+        doc_factory=factory,
+        chaos=DEFAULT_CHAOS_SPEC,
+        chaos_quiesce=6,
+        check_patches=False,
+    )
+    assert plan.stats["device_launch"]["failed"] == 6  # faults actually landed
+
+
+@pytest.mark.chaos
+@pytest.mark.skipif(
+    os.environ.get("PERITEXT_SLOW") != "1", reason="slow; set PERITEXT_SLOW=1"
+)
+def test_chaos_soak():
+    """Long seeded chaos soak (PERITEXT_SLOW=1): growth-profile workload
+    under delivery chaos, quiescing every 10 iterations."""
+    fuzz(
+        iterations=400,
+        seed=17,
+        chaos="pubsub_deliver:drop=0.3,dup=0.25,reorder=0.3",
+        chaos_quiesce=10,
+        growth=True,
+        growth_target=800,
+    )

@@ -123,6 +123,71 @@ def test_pubsub_queue_editor_wiring_end_to_end():
     assert all(d.get_text_with_formatting(["text"]) == expected for d in docs)
 
 
+def test_apply_changes_divergence_carries_pending_changes():
+    """On divergence the error names the still-pending (actor, seq) pairs —
+    chaos-test triage needs to know exactly which deliveries went missing."""
+    from peritext_tpu.runtime import ConvergenceError
+
+    docs, _, _ = generate_docs("abc")
+    doc1, _ = docs
+    _c1, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 3, "values": ["d"]}])
+    c2, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 4, "values": ["e"]}])
+    c3, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 5, "values": ["f"]}])
+    fresh = Doc("fresh")
+    with pytest.raises(ConvergenceError) as excinfo:
+        apply_changes(fresh, [c3, c2])  # c1 and genesis withheld
+    err = excinfo.value
+    assert set(err.pending_ids) == {("doc1", c2["seq"]), ("doc1", c3["seq"])}
+    assert err.pending[0]["actor"] == "doc1"
+    assert "doc1@" in str(err)
+
+
+def test_apply_changes_allow_gaps_applies_ready_prefix():
+    docs, _, initial = generate_docs("abc")
+    doc1, _ = docs
+    c1, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 3, "values": ["d"]}])
+    c2, _ = doc1.change([{"path": ["text"], "action": "insert", "index": 4, "values": ["e"]}])
+    fresh = Doc("fresh")
+    # c1 withheld: genesis applies, c2 stays pending without raising.
+    apply_changes(fresh, [c2, initial], allow_gaps=True)
+    assert "".join(fresh.root["text"]) == "abc"
+    apply_changes(fresh, [c1, c2], allow_gaps=True)
+    assert "".join(fresh.root["text"]) == "abcde"
+
+
+def test_change_queue_double_start_keeps_one_timer():
+    queue = ChangeQueue(handle_flush=lambda changes: None, interval=60.0)
+    try:
+        queue.start()
+        first = queue._timer
+        queue.start()  # must be a no-op, not a second chain
+        assert queue._timer is first
+    finally:
+        queue.drop()
+    assert queue._timer is None
+    first.join(timeout=5)  # cancel() wakes the timer thread; it must exit
+    assert not first.is_alive()
+
+
+def test_change_queue_drop_during_tick_cannot_leak_second_timer():
+    """The epoch guard: a tick from a chain that drop() already ended must
+    not re-arm over (or beside) a newer chain's pending timer."""
+    queue = ChangeQueue(handle_flush=lambda changes: None, interval=60.0)
+    try:
+        queue.start()
+        stale_epoch = queue._epoch
+        queue.drop()  # ends the first chain mid-"tick"
+        queue.start()  # a fresh chain with its own timer
+        current = queue._timer
+        queue._tick(stale_epoch)  # the old chain's in-flight tick lands late
+        assert queue._timer is current  # no replacement, no second chain
+        # And a tick from the LIVE chain does re-arm (replaces its timer).
+        queue._tick(queue._epoch)
+        assert queue._timer is not None and queue._timer is not current
+    finally:
+        queue.drop()
+
+
 def test_change_log_record_detects_forked_history():
     """An already-covered seq must equal the stored change; a conflicting
     fork or corrupted entry surfaces instead of silently dropping."""
