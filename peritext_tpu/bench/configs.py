@@ -13,8 +13,15 @@ bridge/oracle at human scale); configs 3-5 are the batched device workloads
    shape needs a v5e-8's HBM; ``scale="small"`` (default off-hardware) runs
    the same *shape* scaled down on whatever mesh exists so the codepath is
    exercised end-to-end, and reports the scale it actually ran.
+6. editor-fleet patched-ingest steady state (the workload the north star
+   serves): repeated apply_changes_with_patches rounds on one universe,
+   cold/warm split.  Honors ``PERITEXT_PATCH_PATH`` (compact-delta scan
+   by default; ``dense`` pins the full-plane A/B baseline, ``scan`` the
+   interleaved fallback), so the dense-vs-delta A/B is two invocations
+   of the same config.
 
-Env knobs: CONFIG5_REPLICAS / CONFIG5_DOC_LEN override config 5's scale.
+Env knobs: CONFIG5_REPLICAS / CONFIG5_DOC_LEN override config 5's scale;
+CONFIG6_REPLICAS / CONFIG6_ROUNDS config 6's.
 """
 from __future__ import annotations
 
@@ -305,7 +312,14 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
             "cohort": stats["cohort"],
             "n_cohorts": stats["n_cohorts"],
             "state_readback_timed": readback,
-            "flatten_chars_per_sec_per_cohort": round(rows * doc_len / flatten_s, 1),
+            # Numerator counts only the AVAILABLE cohort rows (ADVICE r5):
+            # `rows` is padded up to the replica mesh axis with row-0
+            # duplicates, which do cost flatten time but are not real
+            # population throughput.  Both counts are emitted so the pad
+            # overhead stays visible.
+            "flatten_chars_per_sec_per_cohort": round(avail * doc_len / flatten_s, 1),
+            "flatten_rows": rows,
+            "flatten_avail": avail,
             "platform": jax.devices()[0].platform,
             "conditions": measurement_conditions(),
             "note": "streaming-cohort route: aggregate replicas decoupled from "
@@ -402,12 +416,43 @@ def config5_multichip(replicas: int | None = None, doc_len: int | None = None) -
     }
 
 
+def config6_patched_fleet() -> Dict[str, Any]:
+    """Editor-fleet patched steady state through the full universe API
+    (gate, encode, device merge, record readback, host patch assembly).
+
+    The mark-row scan variant follows PERITEXT_PATCH_PATH — unset runs
+    the compact-delta default, ``dense`` the full-plane A/B baseline,
+    ``scan`` the interleaved fallback — so A/B legs are plain re-runs of
+    this config under different env.
+    """
+    from peritext_tpu.bench.workloads import time_patched_fleet
+
+    knob = os.environ.get("PERITEXT_PATCH_PATH")
+    mode = knob if knob in ("dense", "scan") else None
+    r = time_patched_fleet(
+        num_replicas=int(os.environ.get("CONFIG6_REPLICAS", "256")),
+        rounds=int(os.environ.get("CONFIG6_ROUNDS", "4")),
+        mode=mode,
+    )
+    return {
+        "config": 6,
+        "workload": f"{r['replicas']}-replica editor fleet, {r['rounds']} patched "
+        f"ingest rounds, {r['doc_len']}-char docs",
+        "path": r["path"],
+        "patched_cold_ops_per_sec": round(r["patched_cold_ops_per_sec"], 1),
+        "patched_warm_ops_per_sec": round(r["patched_warm_ops_per_sec"], 1),
+        "no_patch_ops_per_sec": round(r["no_patch_ops_per_sec"], 1),
+        "warm_vs_no_patch": round(r["warm_vs_no_patch"], 3),
+    }
+
+
 CONFIGS = {
     1: config1_trace_replay,
     2: config2_fuzz_style,
     3: config3_batched_plain,
     4: config4_batched_marked,
     5: config5_multichip,
+    6: config6_patched_fleet,
 }
 
 

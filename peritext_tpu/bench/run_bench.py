@@ -72,6 +72,7 @@ def main() -> None:
         "vs_baseline": round(tpu["ops_per_sec"] / scalar["ops_per_sec"], 2),
         "platform": jax.devices()[0].platform,
         "path": path,
+        "best_of": tpu.get("best_of", 1),
         "conditions": measurement_conditions(platform=jax.devices()[0].platform),
     }
     # Salvage point: the headline throughput is safe on stdout NOW; if the
@@ -119,6 +120,17 @@ def main() -> None:
             if patches_mode == "ab":
                 p_scan = time_patched_merge(force_scan=True)
                 result["patched_scan_ops_per_sec"] = round(p_scan["ops_per_sec"], 1)
+                # Salvage point: a BENCH_TIMEOUT kill during the dense leg
+                # must not discard the three legs already measured.
+                print(json.dumps(result))
+                sys.stdout.flush()
+                # The full-plane-carry sorted scan, for the compact-delta
+                # A/B at the single-ingest shape (fleet legs below A/B the
+                # steady state).
+                p_dense = time_patched_merge(mode="dense")
+                result["patched_dense_ops_per_sec"] = round(
+                    p_dense["ops_per_sec"], 1
+                )
             print(json.dumps(result))
             sys.stdout.flush()
         except Exception as err:
@@ -141,10 +153,41 @@ def main() -> None:
                 fleet["no_patch_ops_per_sec"], 1
             )
             result["warm_vs_no_patch"] = round(fleet["warm_vs_no_patch"], 3)
+            result["fleet_path"] = fleet["path"]
             print(json.dumps(result))
             sys.stdout.flush()
         except Exception as err:
             print(f"bench: fleet measurement failed: {err}", file=sys.stderr)
+        # BENCH_PATCHES=ab: the dense-vs-delta fleet legs in ONE run —
+        # identical streams (same seed), same universe lifecycle, only the
+        # mark-row scan differs.  Incremental print again: a timeout here
+        # keeps every leg already emitted.
+        if patches_mode == "ab":
+            try:
+                from peritext_tpu.bench.workloads import time_patched_fleet
+
+                dense = time_patched_fleet(mode="dense")
+                result["fleet_dense_cold_ops_per_sec"] = round(
+                    dense["patched_cold_ops_per_sec"], 1
+                )
+                result["fleet_dense_warm_ops_per_sec"] = round(
+                    dense["patched_warm_ops_per_sec"], 1
+                )
+                result["fleet_dense_warm_vs_no_patch"] = round(
+                    dense["warm_vs_no_patch"], 3
+                )
+                warm = result.get("patched_warm_ops_per_sec")
+                if warm:
+                    result["fleet_delta_vs_dense_warm"] = round(
+                        warm / dense["patched_warm_ops_per_sec"], 3
+                    )
+                print(json.dumps(result))
+                sys.stdout.flush()
+            except Exception as err:
+                print(
+                    f"bench: dense fleet A/B measurement failed: {err}",
+                    file=sys.stderr,
+                )
 
 
 if __name__ == "__main__":

@@ -406,12 +406,6 @@ class TpuDoc:
         uni._ensure_capacity(uni.lengths[0], uni.mark_counts[0])
 
         op_rows = np.stack(rows)
-        # Locally applied mark rows occupy table columns exactly like
-        # ingested ones, so they must count toward the allowMultiple group
-        # census (mirrors _commit) — otherwise a later remote ingest on a
-        # locally-overgrown group passes the cached-scan overflow gate and
-        # _group_topk_cols drops carry-bearing columns from its patches.
-        uni._count_multi_groups(op_rows)
         state = self._state()
 
         # Local application runs under the same retry/backoff policy as
@@ -431,6 +425,14 @@ class TpuDoc:
 
         new_state, records = uni._run_launch(attempt)
         uni.states = stack_states([new_state])
+        # Locally applied mark rows occupy table columns exactly like
+        # ingested ones, so they must count toward the allowMultiple group
+        # census — otherwise a later remote ingest on a locally-overgrown
+        # group passes the cached-scan overflow gate and _group_topk_cols
+        # drops carry-bearing columns from its patches.  Folded only AFTER
+        # the successful launch, matching _commit's commit-after-launch
+        # invariant (a failed launch must not overcount the census).
+        uni._count_multi_groups(op_rows)
         # The local interleaved application rewrites boundary rows without
         # maintaining the patched sorted merge's winner cache.
         uni._wcaches = None

@@ -963,6 +963,45 @@ def place_text_batch(
 # chain's.  log2(2M) gather rounds replace the M sequential scan steps.
 
 
+def _batched_anchor_slots(mark_ops, elem_ctr, elem_act, length):
+    """Anchor-slot resolution for a whole mark batch at once.
+
+    Same rules as _mark_slot_context / _apply_mark_fast — including the
+    same-slot -> endOfText walk-order subtlety (peritext.ts:236-241) —
+    batched over the op axis.  Anchors resolve against the *final* element
+    plane and are time-independent, so every batch consumer (the batched
+    mark phase, the first-definition timeline, and the compact-delta patch
+    scan) shares this one definition.  Returns ``(valid, s_slot, e_slot)``
+    with e_slot already remapped to the beyond-any-slot sentinel for
+    endOfText and same-slot anchors.
+    """
+    c = elem_ctr.shape[0]
+    big = jnp.int32(2 * c + 2)
+    ar = jnp.arange(c, dtype=jnp.int32)
+    live = ar < length
+
+    valid = mark_ops[:, K_KIND] == KIND_MARK
+    s_match = (
+        live[None, :]
+        & (elem_ctr[None, :] == mark_ops[:, K_SCTR, None])
+        & (elem_act[None, :] == mark_ops[:, K_SACT, None])
+    )
+    s_slot = 2 * jnp.argmax(s_match, axis=1).astype(jnp.int32) + mark_ops[:, K_SKIND]
+    e_match = (
+        live[None, :]
+        & (elem_ctr[None, :] == mark_ops[:, K_ECTR, None])
+        & (elem_act[None, :] == mark_ops[:, K_EACT, None])
+    )
+    e_slot = jnp.where(
+        mark_ops[:, K_EKIND] == 2,
+        big,
+        2 * jnp.argmax(e_match, axis=1).astype(jnp.int32)
+        + jnp.minimum(mark_ops[:, K_EKIND], 1),
+    )
+    e_slot = jnp.where(e_slot == s_slot, big, e_slot)  # same-slot -> endOfText
+    return valid, s_slot, e_slot
+
+
 def _or_accumulate(mask: jax.Array, bit_rows: jax.Array) -> jax.Array:
     """OR of the selected one-hot bit rows: [N, M] bool x [M, W] uint32.
 
@@ -1004,11 +1043,8 @@ def _apply_marks_batch(
     m_ops = mark_ops.shape[0]
     c = elem_ctr.shape[0]
     two_c = 2 * c
-    big = jnp.int32(two_c + 2)
     midx = jnp.arange(m_ops, dtype=jnp.int32)
     slots = jnp.arange(two_c, dtype=jnp.int32)
-    ar = jnp.arange(c, dtype=jnp.int32)
-    live = ar < length
 
     if perm is not None:
         # Flat slot-axis composition (post-splice slot -> pre-splice slot):
@@ -1029,27 +1065,8 @@ def _apply_marks_batch(
         def old_rows(slot_idx):
             return bnd_mask[slot_idx]
 
-    valid = mark_ops[:, K_KIND] == KIND_MARK  # [M]
-
     # Anchor resolution (same rules as _apply_mark_fast, batched).
-    s_match = (
-        live[None, :]
-        & (elem_ctr[None, :] == mark_ops[:, K_SCTR, None])
-        & (elem_act[None, :] == mark_ops[:, K_SACT, None])
-    )
-    s_slot = 2 * jnp.argmax(s_match, axis=1).astype(jnp.int32) + mark_ops[:, K_SKIND]
-    e_match = (
-        live[None, :]
-        & (elem_ctr[None, :] == mark_ops[:, K_ECTR, None])
-        & (elem_act[None, :] == mark_ops[:, K_EACT, None])
-    )
-    e_slot = jnp.where(
-        mark_ops[:, K_EKIND] == 2,
-        big,
-        2 * jnp.argmax(e_match, axis=1).astype(jnp.int32)
-        + jnp.minimum(mark_ops[:, K_EKIND], 1),
-    )
-    e_slot = jnp.where(e_slot == s_slot, big, e_slot)  # same-slot -> endOfText
+    valid, s_slot, e_slot = _batched_anchor_slots(mark_ops, elem_ctr, elem_act, length)
 
     # Bit rows: op m's table index is mark_count + (rank among valid rows).
     # The batch's new bits all land in a narrow WORD WINDOW of the [.., W]
@@ -1464,31 +1481,10 @@ def _sorted_def_first(bnd_def0, mark_ops, elem_ctr, elem_act, length):
     m_ops = mark_ops.shape[0]
     c = elem_ctr.shape[0]
     two_c = 2 * c
-    big = jnp.int32(two_c + 2)
     midx = jnp.arange(m_ops, dtype=jnp.int32)
     slots = jnp.arange(two_c, dtype=jnp.int32)
-    ar = jnp.arange(c, dtype=jnp.int32)
-    live = ar < length
 
-    valid = mark_ops[:, K_KIND] == KIND_MARK
-    s_match = (
-        live[None, :]
-        & (elem_ctr[None, :] == mark_ops[:, K_SCTR, None])
-        & (elem_act[None, :] == mark_ops[:, K_SACT, None])
-    )
-    s_slot = 2 * jnp.argmax(s_match, axis=1).astype(jnp.int32) + mark_ops[:, K_SKIND]
-    e_match = (
-        live[None, :]
-        & (elem_ctr[None, :] == mark_ops[:, K_ECTR, None])
-        & (elem_act[None, :] == mark_ops[:, K_EACT, None])
-    )
-    e_slot = jnp.where(
-        mark_ops[:, K_EKIND] == 2,
-        big,
-        2 * jnp.argmax(e_match, axis=1).astype(jnp.int32)
-        + jnp.minimum(mark_ops[:, K_EKIND], 1),
-    )
-    e_slot = jnp.where(e_slot == s_slot, big, e_slot)
+    valid, s_slot, e_slot = _batched_anchor_slots(mark_ops, elem_ctr, elem_act, length)
 
     WS = (valid & (s_slot < e_slot))[:, None] & (slots[None, :] == s_slot[:, None])
     WE = (valid & (e_slot < two_c))[:, None] & (slots[None, :] == e_slot[:, None])
@@ -1599,16 +1595,11 @@ def _group_topk_cols(mark_type_col, mark_attr_col, op, k: int):
     return cols.astype(jnp.int32), vals > 0
 
 
-def _winner_over_cols(carry, cols, col_ok, mark_cols, ranks):
-    """LWW winner per slot among the given table columns present in the
-    carry rows: [2C, K] work instead of [2C, M]."""
-    mark_ctr, mark_act, mark_action, _mark_type, mark_attr = mark_cols
-    words = (cols // MASK_WORD_BITS).astype(jnp.int32)
-    bits = (cols % MASK_WORD_BITS).astype(jnp.uint32)
-    pres = (jnp.take(carry, words, axis=1) >> bits[None, :]) & jnp.uint32(1)
-    cand = pres.astype(bool) & col_ok[None, :]  # [2C, K]
-    g_ctr = mark_ctr[cols]
-    g_rank = ranks[mark_act[cols]]
+def _winner_over_cand(cand, g_ctr, g_rank, g_action, g_attr):
+    """LWW winner per row among candidate columns (``cand`` [N, K] bool with
+    per-column key/value vectors [K]).  The shared reduction core of
+    _winner_over_cols and the compact-delta scan's group resolution — one
+    definition, so the two patched paths cannot diverge on tie-breaks."""
     neg = jnp.int32(-(2**31) + 1)
     ctrs = jnp.where(cand, g_ctr[None, :], neg)
     max_ctr = jnp.max(ctrs, axis=1)
@@ -1617,8 +1608,8 @@ def _winner_over_cols(carry, cols, col_ok, mark_cols, ranks):
     max_rank = jnp.max(rks, axis=1)
     win = tie & (g_rank[None, :] == max_rank[:, None])
     has = cand.any(axis=1)
-    w_action = jnp.sum(jnp.where(win, mark_action[cols][None, :], 0), axis=1)
-    w_attr = jnp.sum(jnp.where(win, mark_attr[cols][None, :], 0), axis=1)
+    w_action = jnp.sum(jnp.where(win, g_action[None, :], 0), axis=1)
+    w_attr = jnp.sum(jnp.where(win, g_attr[None, :], 0), axis=1)
     return (
         jnp.where(has, max_ctr, jnp.int32(-1)),
         jnp.where(has, max_rank, jnp.int32(-1)),
@@ -1626,6 +1617,412 @@ def _winner_over_cols(carry, cols, col_ok, mark_cols, ranks):
         w_attr,
         has,
     )
+
+
+def _winner_over_cols(carry, cols, col_ok, mark_cols, ranks):
+    """LWW winner per slot among the given table columns present in the
+    carry rows: [2C, K] work instead of [2C, M]."""
+    mark_ctr, mark_act, mark_action, _mark_type, mark_attr = mark_cols
+    words = (cols // MASK_WORD_BITS).astype(jnp.int32)
+    bits = (cols % MASK_WORD_BITS).astype(jnp.uint32)
+    pres = (jnp.take(carry, words, axis=1) >> bits[None, :]) & jnp.uint32(1)
+    cand = pres.astype(bool) & col_ok[None, :]  # [2C, K]
+    return _winner_over_cand(
+        cand, mark_ctr[cols], ranks[mark_act[cols]], mark_action[cols], mark_attr[cols]
+    )
+
+
+def _delta_mark_scan(
+    bnd_mask_base,
+    wcache0,
+    mark_ops,
+    mark_time,
+    mcols_final,
+    elem_ctr,
+    elem_act,
+    length,
+    born,
+    died,
+    def_first,
+    src_ok,
+    src_c,
+    tm,
+    mark_count0,
+    ranks,
+    multi,
+    group_k: int,
+    has_multi: bool,
+    t_act: int,
+    perm=None,
+):
+    """Compact-delta mark-row scan (the default patched path).
+
+    Emits per-step patch records identical to the dense scan in
+    merge_step_sorted_patched — the differential bar is byte-identical
+    assembled Patch streams AND byte-identical post-merge planes — but the
+    full boundary plane is read once and written once per launch, and the
+    winner cache moves through the scan with slot-local writes instead of
+    full-plane selects:
+
+    - ``root_src`` [2C] i32: which slot's PRE-batch row is the full-width
+      base of each slot's current row (-1: zero row).  An anchor write
+      (the rebase write class on _apply_mark) copies its carry source's
+      *pointer* instead of its [W]-word row; pre-batch bits are recovered
+      by composed index reads into the untouched ``bnd_mask0`` plane.
+    - ``win_bits`` [2C, w_act] u32: the active word WINDOW of every row
+      (the only words the batch's new bits can land in — the same window
+      rule as _apply_marks_batch).  In-range bit ORs are one-word-column
+      read-modify-writes; anchor writes copy one row.
+    - ``bw`` [T, 2C] i32: the winning BATCH table column per (type, slot)
+      among this batch's non-allowMultiple ops so far (-1: none).  The
+      dense scan's carried ``[2C, T, 4]`` cache value at any slot is
+      exactly ``LWW(wcache0[root_src[slot]], entry(bw[:, slot]))`` — max
+      over (ctr, rank) is associative, so recording every in-range batch
+      op in ``bw`` and composing against the untouched base cache gives
+      byte-identical winners without gating on the composed current value.
+      Anchor writes copy one ``[T]`` column (plus the root pointer); the
+      full ``[2C, T, 4]`` cache plane is read once (composed gathers) and
+      written once by the post-scan compose, never carried.
+    - ``acc_root``/``acc_win``: the insert rows' inherited-row composition
+      captured at their instants (composed to full [Lt, W] rows after the
+      scan).
+
+    The gated writes use the write-unconditionally/select-the-VALUE shape
+    (``col: where(gate, new, cur); plane: dus(plane, col)``) so XLA keeps
+    the carried buffers in place — ``where(gate, dus(..), plane)`` costs a
+    full-plane copy per step.  allowMultiple group resolution only
+    compiles when the batch actually carries multi ops (``has_multi``),
+    at the host-census-measured width ``group_k`` ≤ PATCH_GROUP_K.
+    ``t_act`` (static, the registry-size pow2 bucket ≤ MAX_MARK_TYPES)
+    sizes the carried batch-winner table's type axis: valid ops' type ids
+    are < NUM_MARK_TYPES ≤ t_act, so the dead padding types — the cache
+    plane is padded to MAX_MARK_TYPES so registration never recompiles —
+    drop out of the per-step traversal; base-plane types ≥ t_act pass
+    through the final compose untouched.
+    """
+    mark_ctr_f, mark_act_f, mark_action_f, mark_type_f, mark_attr_f = mcols_final
+    c = elem_ctr.shape[0]
+    two_c = 2 * c
+    m_ops = mark_ops.shape[0]
+    w_words = bnd_mask_base.shape[-1]
+    n_types = multi.shape[0]
+    slots = jnp.arange(two_c, dtype=jnp.int32)
+    ar_c = jnp.arange(c, dtype=jnp.int32)
+    live_c = ar_c < length
+    empty_wc = jnp.array([-1, -1, 0, 0], jnp.int32)
+    type_ar = jnp.arange(t_act, dtype=jnp.int32)
+
+    valid, s_slots, e_slots = _batched_anchor_slots(
+        mark_ops, elem_ctr, elem_act, length
+    )
+    m_idx0 = jnp.arange(m_ops, dtype=jnp.int32)
+
+    # Window geometry (same rule as _apply_marks_batch; valid rows are a
+    # prefix, so op m's table column is mark_count0 + m).
+    w_act = min((m_ops + MASK_WORD_BITS - 1) // MASK_WORD_BITS + 1, w_words)
+    w0 = jnp.clip(mark_count0 // MASK_WORD_BITS, 0, w_words - w_act)
+    word_ar = jnp.arange(w_act, dtype=jnp.int32)
+    bit_off = mark_count0 + m_idx0 - w0 * MASK_WORD_BITS  # [M] window-relative
+    op_rank_v = ranks[mark_ops[:, K_ACT]]
+    tau_v = jnp.clip(mark_ops[:, K_MTYPE], 0, t_act - 1)
+    is_multi_v = multi[tau_v]
+
+    # The text phase's boundary permutation composes INTO every base-plane
+    # read (the _apply_marks_batch `perm=` trick): with ``perm`` given,
+    # ``bnd_mask_base`` AND ``wcache0`` are the RAW pre-splice planes and
+    # no permuted [2C, W] / [2C, T, 4] copy is ever materialized — both
+    # planes are only read through composed gathers and written once by
+    # the final composes.
+    if perm is not None:
+        pvalid, pflat = perm
+
+        def base_rows(idx, ok):  # post-splice slots -> full-width base rows
+            okc = ok & pvalid[idx]
+            return jnp.where(
+                okc[:, None],
+                bnd_mask_base[pflat[idx]],
+                jnp.uint32(0),
+            )
+
+        def base_words(idx, ok, words):  # [N] slots x [K] words -> [N, K]
+            okc = ok & pvalid[idx]
+            return jnp.where(
+                okc[:, None],
+                bnd_mask_base[pflat[idx][:, None], words[None, :]],
+                jnp.uint32(0),
+            )
+
+        def base_wc_rows(idx, ok):  # slots -> [N, T, 4] base cache rows
+            okc = ok & pvalid[idx]
+            return jnp.where(
+                okc[:, None, None],
+                wcache0[pflat[idx]],
+                empty_wc[None, None, :],
+            )
+
+        def base_wc_tau(idx, ok, t):  # slots -> [N, 4] entries at type t
+            okc = ok & pvalid[idx]
+            return jnp.where(
+                okc[:, None], wcache0[pflat[idx], t], empty_wc[None, :]
+            )
+
+    else:
+
+        def base_rows(idx, ok):
+            return jnp.where(ok[:, None], bnd_mask_base[idx], jnp.uint32(0))
+
+        def base_words(idx, ok, words):
+            return jnp.where(
+                ok[:, None],
+                bnd_mask_base[idx[:, None], words[None, :]],
+                jnp.uint32(0),
+            )
+
+        def base_wc_rows(idx, ok):
+            return jnp.where(
+                ok[:, None, None], wcache0[idx], empty_wc[None, None, :]
+            )
+
+        def base_wc_tau(idx, ok, t):
+            return jnp.where(ok[:, None], wcache0[idx, t], empty_wc[None, :])
+
+    # Carry-independent signals, hoisted OUT of the scan and computed in
+    # one batched pass over the op axis (identical per-op semantics: the
+    # same _walk_signals definition, vmapped).  The scan body keeps only
+    # the carry-dependent work (`changed` + the plane updates).
+    defined_all = def_first[None, :] < m_idx0[:, None]  # [M, 2C]
+    visible_all = (
+        live_c[None, :]
+        & (born[None, :] < mark_time[:, None])
+        & (died[None, :] > mark_time[:, None])
+    )  # [M, C]
+    written_all, during_all, vis_all, final_vis_all = jax.vmap(
+        lambda s, e, d, v: _walk_signals((s, e, slots, d, None, None), v, c)
+    )(s_slots, e_slots, defined_all, visible_all)
+    src_q_all = lax.cummax(
+        jnp.where(defined_all, slots[None, :], jnp.int32(-1)), axis=1
+    )  # [M, 2C]
+
+    def compose_rows(root, win_rows):
+        """(root pointer [N], window words [N, w_act]) -> full [N, W] rows:
+        one gather into the untouched base plane + the w_act static
+        broadcast-selects of the window write-back (PROFILE_r05 step 3)."""
+        base = base_rows(jnp.maximum(root, 0), root >= 0)
+        word_full = jnp.arange(w_words, dtype=jnp.int32)
+        out = base
+        for j in range(w_act):
+            out = jnp.where(
+                word_full[None, :] == w0 + j, win_rows[:, j][:, None], out
+            )
+        return out
+
+    carry0 = (
+        slots,  # root_src: every slot starts as its own base row
+        base_words(slots, slots >= 0, w0 + word_ar),
+        jnp.full((t_act, two_c), -1, jnp.int32),  # bw: no batch winner yet
+        jnp.full(src_c.shape[0], -1, jnp.int32),  # acc_root
+        jnp.zeros((src_c.shape[0], w_act), jnp.uint32),  # acc_win
+    )
+    xs = (
+        mark_ops,
+        m_idx0,
+        s_slots,
+        e_slots,
+        valid,
+        bit_off,
+        op_rank_v,
+        tau_v,
+        is_multi_v,
+        during_all,
+        src_q_all,
+    )
+
+    def bw_entry(colv):
+        """Batch-winner table columns -> (ctr, rank, action, attr) entries
+        ([-1, -1, 0, 0] where no batch winner); columns >= mark_count0 are
+        exactly this batch's ops, so the final mark table holds them."""
+        ok = colv >= 0
+        cc = jnp.maximum(colv, 0)
+        return jnp.stack(
+            [
+                jnp.where(ok, mark_ctr_f[cc], jnp.int32(-1)),
+                jnp.where(ok, ranks[mark_act_f[cc]], jnp.int32(-1)),
+                jnp.where(ok, mark_action_f[cc], jnp.int32(0)),
+                jnp.where(ok, mark_attr_f[cc], jnp.int32(0)),
+            ],
+            axis=-1,
+        )
+
+    def lww(a, b):
+        """Pick b where it beats a on (ctr, rank) — the dense scan's
+        `beats` rule, applied entrywise to [..., 4] cache entries."""
+        pick = (b[..., 0] > a[..., 0]) | (
+            (b[..., 0] == a[..., 0]) & (b[..., 1] > a[..., 1])
+        )
+        return jnp.where(pick[..., None], b, a)
+
+    def step(carry, xs_t):
+        root_src, win_bits, bw, acc_root, acc_win = carry
+        (op, m_idx, s_sl, e_sl, val, bo, op_rank, tau, is_mop,
+         during, src_q) = xs_t
+        wb = bo // MASK_WORD_BITS
+        bit_u = jnp.uint32(1) << (bo % MASK_WORD_BITS).astype(jnp.uint32)
+        defined = def_first < m_idx
+
+        # Inserts whose instant lands at this plane version capture their
+        # inherited row's composition BEFORE this mark writes (same
+        # read-at-step-start as the dense scan; pad steps never write, so
+        # any tm landing on a pad index still reads the right version).
+        take = src_ok & (tm == m_idx)
+        acc_root = jnp.where(take, root_src[src_c], acc_root)
+        acc_win = jnp.where(take[:, None], win_bits[src_c], acc_win)
+
+        # `changed`: the op's group winner within the inherited set at each
+        # slot's carry source, composed on the fly — the untouched base
+        # cache gathered at the source's ROOT, LWW'd against the carried
+        # batch-winner column — a few [2C] gathers where the dense scan
+        # materialized a full [2C, T, 4] carry select.
+        q_ok = src_q >= 0
+        qc = jnp.maximum(src_q, 0)
+        rootq = jnp.where(q_ok, root_src[qc], jnp.int32(-1))
+        rq_ok = rootq >= 0
+        rqc = jnp.maximum(rootq, 0)
+        bw_tau = lax.dynamic_slice(bw, (tau, 0), (1, two_c))[0]  # [2C]
+        base_e = base_wc_tau(rqc, rq_ok, tau)
+        wnm = lww(
+            base_e, bw_entry(jnp.where(q_ok, bw_tau[qc], jnp.int32(-1)))
+        )  # [2C, 4]
+        w_ctr, w_rank = wnm[:, 0], wnm[:, 1]
+        w_action, w_attr = wnm[:, 2], wnm[:, 3]
+        has_winner = w_ctr >= 0
+
+        if has_multi:
+            # allowMultiple groups resolve over their (host-gated, host-
+            # sized) compacted columns; presence composes window words from
+            # the carry with non-window words from the untouched base plane
+            # at the row's root (rootq/rqc shared with the `changed` read).
+            cols, col_ok = _group_topk_cols(mark_type_f, mark_attr_f, op, group_k)
+            words = (cols // MASK_WORD_BITS).astype(jnp.int32)
+            bits = (cols % MASK_WORD_BITS).astype(jnp.uint32)
+            in_win = (words >= w0) & (words < w0 + w_act)
+            win_part = jnp.take(
+                win_bits[qc], jnp.clip(words - w0, 0, w_act - 1), axis=1
+            )
+            base_part = base_words(rqc, rq_ok, words)
+            word_val = jnp.where(
+                q_ok[:, None],
+                jnp.where(in_win[None, :], win_part, base_part),
+                jnp.uint32(0),
+            )
+            pres = ((word_val >> bits[None, :]) & jnp.uint32(1)).astype(bool)
+            g_ctr, g_rank, g_action, g_attr, g_has = _winner_over_cand(
+                pres & col_ok[None, :],
+                mark_ctr_f[cols],
+                ranks[mark_act_f[cols]],
+                mark_action_f[cols],
+                mark_attr_f[cols],
+            )
+            w_ctr = jnp.where(is_mop, g_ctr, w_ctr)
+            w_rank = jnp.where(is_mop, g_rank, w_rank)
+            w_action = jnp.where(is_mop, g_action, w_action)
+            w_attr = jnp.where(is_mop, g_attr, w_attr)
+            has_winner = jnp.where(is_mop, g_has, has_winner)
+
+        changed = _changed_vs_winner(
+            op, op_rank, w_ctr, w_rank, w_action, w_attr, has_winner
+        )
+
+        # --- apply the op to the carry ---------------------------------
+        # All write values read the PRE-update carry (the dense scan's
+        # writes are simultaneous); every update is an ELEMENTWISE select
+        # keyed on slot one-hots, so XLA fuses the whole chain into one
+        # traversal of each carried plane per step.  (The batched-index
+        # dynamic-update-slice formulation lowers to near-serial scatters
+        # under vmap on CPU and to per-replica sub-loops on TPU — measured
+        # strictly worse on both.)
+        s_lt_e = s_sl < e_sl
+        write_s = val & s_lt_e
+        write_e = val & (e_sl < two_c)
+        e_cl = jnp.minimum(e_sl, jnp.int32(two_c - 1))
+        q_s = src_q[s_sl]
+        q_e = src_q[e_cl]
+        root_s_v = jnp.where(q_s >= 0, root_src[jnp.maximum(q_s, 0)], jnp.int32(-1))
+        root_e_v = jnp.where(q_e >= 0, root_src[jnp.maximum(q_e, 0)], jnp.int32(-1))
+        win_row_s = jnp.where(q_s >= 0, win_bits[jnp.maximum(q_s, 0)], jnp.uint32(0))
+        win_row_e = jnp.where(q_e >= 0, win_bits[jnp.maximum(q_e, 0)], jnp.uint32(0))
+        col_s = jnp.where(q_s >= 0, bw[:, jnp.maximum(q_s, 0)], jnp.int32(-1))
+        col_e = jnp.where(q_e >= 0, bw[:, jnp.maximum(q_e, 0)], jnp.int32(-1))
+        one_s = (slots == s_sl) & write_s
+        one_e = (slots == e_cl) & write_e
+        inr_def = during & defined & val
+
+        # Window words: in-range bit OR (only the op's word can change) +
+        # the two anchor-row rebases.
+        bit_at = inr_def[:, None] & (word_ar == wb)[None, :]
+        win_bits = jnp.where(bit_at, win_bits | bit_u, win_bits)
+        bit_row = jnp.where(word_ar == wb, bit_u, jnp.uint32(0))
+        win_bits = jnp.where(one_s[:, None], (win_row_s | bit_row)[None, :], win_bits)
+        win_bits = jnp.where(one_e[:, None], win_row_e[None, :], win_bits)
+        root_src = jnp.where(one_s, root_s_v, root_src)
+        root_src = jnp.where(one_e, root_e_v, root_src)
+
+        # Batch-winner table: record the op's column into its own type's
+        # row over in-range defined slots where it beats the current BATCH
+        # winner (non-allowMultiple only — the dense `beats_nm` update
+        # class; gating on the composed-with-base value is unnecessary,
+        # max over (ctr, rank) is associative and the final compose takes
+        # the same max), then the two anchor-COLUMN rebases.
+        cur = bw_entry(bw_tau)
+        beats = (bw_tau < 0) | (op[K_CTR] > cur[:, 0]) | (
+            (op[K_CTR] == cur[:, 0]) & (op_rank > cur[:, 1])
+        )
+        tau_oh = type_ar == tau
+        upd_inr = inr_def & ~is_mop & beats
+        op_col = mark_count0 + m_idx
+        bw = jnp.where(upd_inr[None, :] & tau_oh[:, None], op_col, bw)
+        cs_tau = col_s[tau]
+        cs = bw_entry(cs_tau[None])[0]
+        s_beats = (cs_tau < 0) | (op[K_CTR] > cs[0]) | (
+            (op[K_CTR] == cs[0]) & (op_rank > cs[1])
+        )
+        new_col = jnp.where(~is_mop & s_beats, op_col, cs_tau)
+        col_s = jnp.where(tau_oh, new_col, col_s)
+        bw = jnp.where(one_s[None, :], col_s[:, None], bw)
+        bw = jnp.where(one_e[None, :], col_e[:, None], bw)
+
+        return (root_src, win_bits, bw, acc_root, acc_win), changed & val
+
+    (root_src_f, win_f, bw_f, acc_root, acc_win), changed_all = lax.scan(
+        step, carry0, xs
+    )
+    mrec = {
+        "written": written_all & valid[:, None],
+        "during": during_all & valid[:, None],
+        "changed": changed_all,
+        "vis": vis_all,
+        "obj_len": final_vis_all,
+    }
+
+    # Inserts after every mark instant read the final composition.
+    take_f = src_ok & (tm == m_ops)
+    acc_root = jnp.where(take_f, root_src_f[src_c], acc_root)
+    acc_win = jnp.where(take_f[:, None], win_f[src_c], acc_win)
+    ins_mask = compose_rows(acc_root, acc_win)
+
+    # Final planes: ONE composed gather over the untouched base plane +
+    # the window write-back; definedness is fully analytic (anchor writes
+    # are the only first definitions, _sorted_def_first); the final winner
+    # cache composes the same way — base rows gathered at each slot's
+    # root, LWW'd against the batch winners — the launch's only full
+    # [2C, T, 4] read + write.
+    new_mask = compose_rows(root_src_f, win_f)
+    new_def = def_first <= m_ops
+    base_wc = base_wc_rows(jnp.maximum(root_src_f, 0), root_src_f >= 0)
+    bw_vals = jnp.swapaxes(bw_entry(bw_f), 0, 1)  # [2C, t_act, 4]
+    wcache_f = jnp.concatenate(
+        [lww(base_wc[:, :t_act], bw_vals), base_wc[:, t_act:]], axis=1
+    )
+    return new_def, new_mask, ins_mask, mrec, wcache_f
 
 
 def merge_step_sorted_patched(
@@ -1642,6 +2039,10 @@ def merge_step_sorted_patched(
     maxk: int,
     has_marks: bool = True,
     wcache_in: jax.Array | None = None,
+    mode: str = "delta",
+    group_k: int | None = None,
+    has_multi: bool = True,
+    t_act: int | None = None,
 ):
     """Sorted merge that also emits per-op patch records.
 
@@ -1663,6 +2064,15 @@ def merge_step_sorted_patched(
     Returns ``(new_state, records)``; records carry ``wcache`` (final,
     post-batch coordinates) for the universe to persist — except on the
     cacheless mark-free path, which neither needs nor produces one.
+
+    ``mode`` selects the mark-row scan's carry representation: "delta"
+    (default) runs the compact-delta scan (_delta_mark_scan — composition
+    pointers + window words carried; the full [2C, W] / [2C, T, 4] planes
+    read once and written once per launch), "dense" the original
+    full-plane-carry scan below.  Both are byte-identical in records and
+    state; PERITEXT_PATCH_PATH=dense forces the dense variant for A/B.
+    ``group_k``/``has_multi`` statically specialize the delta scan's
+    allowMultiple group resolution from the host census.
     """
     elem_ctr, elem_act, deleted, chars, orig_idx, length = place_text_batch(
         state.elem_ctr,
@@ -1677,7 +2087,19 @@ def merge_step_sorted_patched(
         char_buf,
         maxk,
     )
-    bnd_def0, bnd_mask0 = _permute_boundaries(state.bnd_def, state.bnd_mask, orig_idx)
+    pvalid_p, pflat_p = _slot_permutation(orig_idx)
+    bnd_def0 = jnp.where(pvalid_p, state.bnd_def[pflat_p], False)
+    # The compact-delta warm path never materializes the permuted mask
+    # plane: the scan reads the RAW plane through the composed permutation
+    # and writes the final plane once (its compose).  Every other path
+    # (dense, mark-free, and the cold dominance init, which expands the
+    # full plane anyway) materializes it here, exactly as before.
+    delta_composed = mode == "delta" and has_marks and wcache_in is not None
+    bnd_mask0 = (
+        None
+        if delta_composed
+        else jnp.where(pvalid_p[:, None], state.bnd_mask[pflat_p], jnp.uint32(0))
+    )
     mark_valid = mark_ops[:, K_KIND] == KIND_MARK
     born, died, q, index0, tvalid, tm = _sorted_text_records(
         elem_ctr, elem_act, orig_idx, length, state.deleted,
@@ -1768,13 +2190,77 @@ def merge_step_sorted_patched(
             records["wcache"] = _permute_wcache(wcache_in, orig_idx)
         return new_state, records
 
-    wcache0 = (
-        _permute_wcache(wcache_in, orig_idx)
-        if wcache_in is not None
-        else _winner_cache_init(
-            bnd_mask0, mcols_final, ranks, n_types, state.max_mark_ops, multi
+    # The compact-delta warm path also never materializes the permuted
+    # winner cache: the scan reads the cache only through gathers, so the
+    # slot permutation composes into them exactly as the boundary plane's
+    # does, and the [2C, T, 4] permute copy disappears from the launch.
+    if delta_composed:
+        wcache0 = wcache_in
+    else:
+        wcache0 = (
+            _permute_wcache(wcache_in, orig_idx)
+            if wcache_in is not None
+            else _winner_cache_init(
+                bnd_mask0, mcols_final, ranks, n_types, state.max_mark_ops, multi
+            )
         )
-    )
+
+    if mode == "delta":
+        # Compact-delta mark-row scan: the carry holds only the batch's
+        # composition state; the full [2C, W] / [2C, T, 4] planes are read
+        # once and written once per launch (see _delta_mark_scan).
+        bnd_def, bnd_mask, ins_mask, mrec, wcache_f = _delta_mark_scan(
+            state.bnd_mask if delta_composed else bnd_mask0,
+            wcache0,
+            mark_ops,
+            mark_time,
+            mcols_final,
+            elem_ctr,
+            elem_act,
+            length,
+            born,
+            died,
+            def_first,
+            src_ok,
+            src_c,
+            tm,
+            state.mark_count,
+            ranks,
+            multi,
+            group_k if group_k is not None else PATCH_GROUP_K,
+            has_multi,
+            t_act if t_act is not None else n_types,
+            perm=(pvalid_p, pflat_p) if delta_composed else None,
+        )
+        new_state = DocState(
+            elem_ctr=elem_ctr,
+            elem_act=elem_act,
+            deleted=deleted,
+            chars=chars,
+            bnd_def=bnd_def,
+            bnd_mask=bnd_mask,
+            mark_ctr=mark_ctr_f,
+            mark_act=mark_act_f,
+            mark_action=mark_action_f,
+            mark_type=mark_type_f,
+            mark_attr=mark_attr_f,
+            length=length,
+            mark_count=mark_count_f,
+        )
+        records = {
+            "kind": kind_t,
+            "tvalid": tvalid,
+            "index0": index0,
+            "ins_mask": ins_mask,
+            "written": mrec["written"],
+            "during": mrec["during"],
+            "changed": mrec["changed"],
+            "vis": mrec["vis"],
+            "obj_len": mrec["obj_len"],
+            "wcache": wcache_f,
+        }
+        return new_state, records
+
     ar_c = jnp.arange(c, dtype=jnp.int32)
     empty_wc = jnp.array([-1, -1, 0, 0], jnp.int32)
 
@@ -1915,12 +2401,23 @@ def merge_step_sorted_patched(
 
 
 @functools.lru_cache(maxsize=None)
-def _merge_step_sorted_patched_batch(maxk: int, has_marks: bool, has_wcache: bool):
+def _merge_step_sorted_patched_batch(
+    maxk: int,
+    has_marks: bool,
+    has_wcache: bool,
+    mode: str,
+    group_k: int | None,
+    has_multi: bool,
+    t_act: int | None,
+):
+    kw = dict(
+        maxk=maxk, has_marks=has_marks, mode=mode, group_k=group_k,
+        has_multi=has_multi, t_act=t_act,
+    )
     if has_wcache:
         def call(st, t, ro, nr, m, rk, b, mu, tt, mt, wc):
             return merge_step_sorted_patched(
-                st, t, ro, nr, m, rk, b, mu, tt, mt,
-                maxk=maxk, has_marks=has_marks, wcache_in=wc,
+                st, t, ro, nr, m, rk, b, mu, tt, mt, wcache_in=wc, **kw
             )
 
         return jax.jit(
@@ -1928,9 +2425,7 @@ def _merge_step_sorted_patched_batch(maxk: int, has_marks: bool, has_wcache: boo
         )
     return jax.jit(
         jax.vmap(
-            functools.partial(
-                merge_step_sorted_patched, maxk=maxk, has_marks=has_marks
-            ),
+            functools.partial(merge_step_sorted_patched, **kw),
             in_axes=(0, 0, 0, None, 0, None, 0, None, 0, 0),
         )
     )
@@ -1950,6 +2445,10 @@ def merge_step_sorted_patched_batch(
     maxk: int,
     has_marks: bool = True,
     wcache_in=None,
+    mode: str = "delta",
+    group_k: int | None = None,
+    has_multi: bool = True,
+    t_act: int | None = None,
 ):
     """Jitted batched entry point for the patch-emitting sorted merge.
 
@@ -1957,8 +2456,22 @@ def merge_step_sorted_patched_batch(
     mark-free fast path: no winner-cache init, no mark scan.
     ``wcache_in`` ([R, 2C, T, 4]) threads the persisted winner cache; when
     given, the marked path compiles WITHOUT the dominance init.
+    ``mode`` selects the mark-row scan: "delta" (default — compact carry,
+    full planes read/written once per launch) or "dense" (the full-plane
+    carry variant, kept for A/B via PERITEXT_PATCH_PATH=dense).  Both emit
+    byte-identical patch streams and states.  ``group_k``/``has_multi``/
+    ``t_act`` are delta-only static specializations from the host's
+    allowMultiple group census and mark-type registry (dense always
+    compiles the full PATCH_GROUP_K / MAX_MARK_TYPES machinery); they are
+    normalized here so dense mode keeps ONE jit cache entry.
     """
-    fn = _merge_step_sorted_patched_batch(maxk, has_marks, wcache_in is not None)
+    if mode not in ("delta", "dense"):
+        raise ValueError(f"unknown patched merge mode {mode!r}")
+    if mode == "dense" or not has_marks:
+        group_k, has_multi, t_act = None, True, None
+    fn = _merge_step_sorted_patched_batch(
+        maxk, has_marks, wcache_in is not None, mode, group_k, has_multi, t_act
+    )
     args = [
         states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks,
         char_buf, multi, text_time, mark_time,

@@ -60,8 +60,69 @@ def _var_roll(x, amt, nbits: int):
     for bit in range(nbits):
         rolled = pltpu.roll(out, 1 << bit, 1)
         sel = ((amt >> bit) & 1) != 0  # [B, 1] broadcasts over lanes
-        out = jnp.where(sel, rolled, out)
+        out = jnp.where(_col_lanes(sel, out), rolled, out)
     return out
+
+
+def _imin(v, axis=1):
+    """Lane min of integer index values via an exact f32 reduction.
+
+    Mosaic on the 0.4.x toolchain lowers NO integer reductions (newer
+    releases do; the image's pinned jax moves between rounds), while f32
+    reductions always lower.  In-kernel reduced values are slot/position
+    indices bounded by 2C <= 32768 (plus -1/C sentinels) — integral and
+    far below 2**24, so the f32 round-trip is exact, not approximate.
+    """
+    return jnp.min(v.astype(jnp.float32), axis=axis, keepdims=True).astype(
+        jnp.int32
+    )
+
+
+def _imax(v, axis=1):
+    """Lane max of integer index values via an exact f32 reduction
+    (see _imin for the lowering + exactness argument)."""
+    return jnp.max(v.astype(jnp.float32), axis=axis, keepdims=True).astype(
+        jnp.int32
+    )
+
+
+def _one_hot_sum32(v, axis=1):
+    """Sum of int32 lanes of which AT MOST ONE is nonzero per row — the
+    masked-sum extraction idiom — via two f32 half-sums.
+
+    Unlike _imin/_imax values, these lanes hold full 32-bit mask words
+    (top bit may be set), so one f32 sum would round.  Each 16-bit half
+    is in [0, 65535] and only one lane contributes, so both half-sums are
+    integral and < 2**24 (exact); the halves then recombine bitwise.
+    ``v >> 16`` is the int32 arithmetic shift and ``hi << 16`` wraps into
+    the sign bit — both defined, reconstructing the exact bit pattern.
+    """
+    lo = jnp.sum(
+        (v & 0xFFFF).astype(jnp.float32), axis=axis, keepdims=True
+    ).astype(jnp.int32)
+    hi = jnp.sum(
+        ((v >> 16) & 0xFFFF).astype(jnp.float32), axis=axis, keepdims=True
+    ).astype(jnp.int32)
+    return (hi << 16) | lo
+
+
+def _col_i32(cond_col, like):
+    """Broadcast a [B, 1] boolean column across lanes -> [B, L] int32 0/1.
+
+    Routed through int32: the 0.4.x Mosaic cannot legalize the i1
+    lane-broadcast of a dynamic-layout vector (tpu.dynamic_gather on
+    vector<..xi1>), which every boolean column read off the dynamically
+    rolled op row needs; the i32 broadcast lowers on every vintage.  The
+    0/1 plane also composes with other predicates by MULTIPLY, dodging the
+    same vintage's inability to relayout i1 vectors whose mask layouts
+    differ ("Can't change bitwidth during a relayout").
+    """
+    return cond_col.astype(jnp.int32) + jnp.zeros_like(like, dtype=jnp.int32)
+
+
+def _col_lanes(cond_col, like):
+    """[B, 1] boolean column -> [B, L] bool lane-broadcast (see _col_i32)."""
+    return _col_i32(cond_col, like) != 0
 
 
 def _pad_lanes_128(x):
@@ -129,7 +190,7 @@ def _text_kernel(ops_ref, cb_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_i
         k = jnp.where(is_run, col(K.K_RUN_LEN), 1)  # [B, 1] block width
 
         match = live & (ecv == ref_ctr) & (eav == ref_act)
-        dlv = jnp.where(match & is_del, 1, dlv)
+        dlv = jnp.where(match & _col_lanes(is_del, match), 1, dlv)
 
         # RGA position rule (kernels._rga_insert_position, vectorized over
         # the replica sublane): after the reference element, past the
@@ -137,11 +198,11 @@ def _text_kernel(ops_ref, cb_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_i
         # position of its first op (see kernels._apply_text_op's contiguity
         # argument for why the whole chain lands contiguously there).
         is_head = (ref_ctr == 0) & (ref_act == 0)
-        first = jnp.min(jnp.where(match, pos, c), axis=1, keepdims=True)
+        first = _imin(jnp.where(match, pos, c))
         idx = jnp.where(is_head, -1, first)
         gt = (ecv > ctr) | ((ecv == ctr) & (erv > op_rank))
         stop = (pos > idx) & ~(live & gt)
-        t = jnp.min(jnp.where(stop, pos, c), axis=1, keepdims=True)
+        t = _imin(jnp.where(stop, pos, c))
         keep = pos < t
         block = ~keep & (pos < t + k)
         offset = pos - t
@@ -152,17 +213,18 @@ def _text_kernel(ops_ref, cb_ref, ec_in, ea_in, er_in, dl_in, ch_in, oi_in, ln_i
         cbv = cb_ref[:]
         amt = jnp.remainder(t - payload, w2)
         rolled_cb = _var_roll(cbv, amt, w2_bits)[:, :c]
-        char_vals = jnp.where(is_run, rolled_cb, payload)
+        char_vals = jnp.where(_col_lanes(is_run, rolled_cb), rolled_cb, payload)
 
         def splice(x, v):
             return jnp.where(keep, x, jnp.where(block, v, _var_roll(x, k, k_bits)))
 
-        ec[:] = jnp.where(any_ins, splice(ecv, ctr + offset), ecv)
-        ea[:] = jnp.where(any_ins, splice(eav, act), eav)
-        er[:] = jnp.where(any_ins, splice(erv, op_rank), erv)
-        dl[:] = jnp.where(any_ins, splice(dlv, 0), dlv)
-        ch[:] = jnp.where(any_ins, splice(chv, char_vals), chv)
-        oi[:] = jnp.where(any_ins, splice(oiv, -1), oiv)
+        ins_lanes = _col_lanes(any_ins, ecv)
+        ec[:] = jnp.where(ins_lanes, splice(ecv, ctr + offset), ecv)
+        ea[:] = jnp.where(ins_lanes, splice(eav, act), eav)
+        er[:] = jnp.where(ins_lanes, splice(erv, op_rank), erv)
+        dl[:] = jnp.where(ins_lanes, splice(dlv, 0), dlv)
+        ch[:] = jnp.where(ins_lanes, splice(chv, char_vals), chv)
+        oi[:] = jnp.where(ins_lanes, splice(oiv, -1), oiv)
         ln[:] = lnv + jnp.where(any_ins, k, 0)
         return 0
 
@@ -323,7 +385,7 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         # fallback, so unresolved anchors behave identically on both paths.
         def first_match(mctr, mact):
             match = live_e & (ecv == mctr) & (eav == mact)
-            first = jnp.min(jnp.where(match, pos, c), axis=1, keepdims=True)
+            first = _imin(jnp.where(match, pos, c))
             return jnp.where(first == c, 0, first)
 
         s_slot = 2 * first_match(col(K.K_SCTR), col(K.K_SACT)) + col(K.K_SKIND)
@@ -336,7 +398,12 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         e_slot = jnp.where(e_slot == s_slot, 2 * c + 2, e_slot)
 
         dfv = def_out[:]
-        defined = (dfv != 0) & (slot2 < 2 * ln)
+        # Predicate planes in this kernel compose as int32 0/1 products
+        # rather than i1 conjunctions: the 0.4.x Mosaic cannot relayout i1
+        # vectors whose internal mask layouts differ, and these planes mix
+        # iota-compare masks with broadcast columns (_col_i32).  dfv is
+        # already 0/1.
+        defined_i = dfv * jnp.where(slot2 < 2 * ln, 1, 0)  # [B, 2C] 0/1
         mkv = mask_out[:]
 
         m = mcount_out[:]  # [B, 1]
@@ -348,47 +415,52 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
         word_of_m = m // MASK_WORD_BITS
 
         s_lt_e = s_slot < e_slot
-        in_range2 = (slot2 >= s_slot) & (slot2 < e_slot) & s_lt_e & is_mark
+        in_range2_i = (
+            jnp.where(slot2 >= s_slot, 1, 0)
+            * jnp.where(slot2 < e_slot, 1, 0)
+            * _col_i32(s_lt_e & is_mark, slot2)
+        )  # [B, 2C] 0/1
 
         # Carry rows for s and e: masked max over lanes per word block.
         # The per-block reduction loops over the (small, static) word count
         # with 2D masked maxes instead of a 3D reshape, which Mosaic may
         # not lower.
         def carry_row(target_slot):
-            src = jnp.max(
-                jnp.where(defined & (slot2 <= target_slot), slot2, -1),
-                axis=1,
-                keepdims=True,
+            src = _imax(
+                jnp.where(
+                    (defined_i * jnp.where(slot2 <= target_slot, 1, 0)) != 0,
+                    slot2,
+                    -1,
+                )
             )  # [B, 1]
             sel = lane_slot == src  # [B, W*2C]; no lane selected when src=-1
             # At most one lane is selected per word block, so a masked sum
             # extracts exactly that value (and 0 when src=-1) — unlike max,
-            # it also lowers (no unsigned reductions in Mosaic) and stays
-            # exact for int32-bitcast masks with the top bit set.
+            # it also lowers on every Mosaic vintage (via the half-split
+            # _one_hot_sum32, exact for int32-bitcast masks with the top
+            # bit set).
             vals = jnp.where(sel, mkv, 0)
             cols = [
-                jnp.sum(
-                    jnp.where(lane_word == j, vals, 0),
-                    axis=1,
-                    keepdims=True,
-                )
+                _one_hot_sum32(jnp.where(lane_word == j, vals, 0))
                 for j in range(w)
             ]
             return jnp.concatenate(cols, axis=1)  # [B, W]
 
         row_s = carry_row(s_slot)  # [B, W]
-        bit_blocks = jnp.where(
-            jnp.arange(w, dtype=jnp.int32)[None, :] == word_of_m, bit, 0
-        )  # [B, W]
+        # 2D iota from the start: a 1D arange + reshape is an <2D iota to
+        # Mosaic, which the 0.4.x vintage refuses to lower.
+        word_idx = lax.broadcasted_iota(jnp.int32, (b, w), 1)
+        bit_blocks = jnp.where(word_idx == word_of_m, bit, 0)  # [B, W]
         row_s = row_s | bit_blocks
         e_clamped = jnp.minimum(e_slot, 2 * c - 1)
         row_e = carry_row(e_clamped)
 
         # 1) OR the bit into defined in-range lanes of word word_of_m.
-        or_mask = in_range2  # [B, 2C] slot-level
-        or_slots = or_mask & defined
-        or_lanes = jnp.concatenate([or_slots] * w, axis=1) & (lane_word == word_of_m)
-        new_mask = jnp.where(or_lanes, mkv | bit, mkv)
+        or_slots_i = in_range2_i * defined_i  # [B, 2C] 0/1
+        or_lanes_i = jnp.concatenate([or_slots_i] * w, axis=1) * jnp.where(
+            lane_word == word_of_m, 1, 0
+        )
+        new_mask = jnp.where(or_lanes_i != 0, mkv | bit, mkv)
 
         # Word-major lane expansion of [B, W] word values: lane l takes
         # rows[:, l // 2C].  A static select per word block keeps every op
@@ -402,20 +474,24 @@ def _mark_kernel(ops_ref, def_in, mask_in, ec_in, ea_in, ln_in, mc_in,
 
         # 2) slot s write: row_s word values at lanes lane_slot == s_slot.
         write_s = is_mark & s_lt_e
-        s_lanes = (lane_slot == s_slot) & write_s
-        new_mask = jnp.where(s_lanes, expand_rows(row_s), new_mask)
+        s_lanes_i = jnp.where(lane_slot == s_slot, 1, 0) * _col_i32(
+            write_s, lane_slot
+        )
+        new_mask = jnp.where(s_lanes_i != 0, expand_rows(row_s), new_mask)
 
         # 3) slot e write (skipped for endOfText).
         write_e = is_mark & (e_slot < 2 * c)
-        e_lanes = (lane_slot == e_slot) & write_e
-        new_mask = jnp.where(e_lanes, expand_rows(row_e), new_mask)
+        e_lanes_i = jnp.where(lane_slot == e_slot, 1, 0) * _col_i32(
+            write_e, lane_slot
+        )
+        new_mask = jnp.where(e_lanes_i != 0, expand_rows(row_e), new_mask)
 
         mask_out[:] = new_mask
         new_def = (
             dfv
-            | (or_mask & defined).astype(jnp.int32)
-            | ((slot2 == s_slot) & write_s).astype(jnp.int32)
-            | ((slot2 == e_slot) & write_e).astype(jnp.int32)
+            | or_slots_i
+            | (jnp.where(slot2 == s_slot, 1, 0) * _col_i32(write_s, slot2))
+            | (jnp.where(slot2 == e_slot, 1, 0) * _col_i32(write_e, slot2))
         )
         def_out[:] = new_def
         mcount_out[:] = m + is_mark.astype(jnp.int32)

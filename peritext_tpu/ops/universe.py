@@ -785,18 +785,24 @@ class TpuUniverse:
         """Fold a batch's allowMultiple mark rows into _multi_groups."""
         fold_multi_group_rows(self._multi_groups, rows)
 
-    def _multi_group_overflow(self, extra_rows: List[np.ndarray], cap: int) -> bool:
-        """Would any allowMultiple group exceed ``cap`` distinct ops once
-        ``extra_rows`` land?  (Conservative: unioned over all replicas.)"""
+    def _multi_group_need(self, extra_rows: List[np.ndarray]) -> int:
+        """Largest allowMultiple resolution group any of this batch's multi
+        ops targets, once ``extra_rows`` land (conservative: unioned over
+        all replicas; 0 when the batch carries no multi ops).  Only groups
+        the batch actually resolves matter: the cached scan compacts
+        columns per *batch* multi op, so untargeted groups can grow past
+        the cap without affecting correctness.  Sizes saturate at
+        PATCH_GROUP_K + 1 (the census cap), which is all the overflow gate
+        and the delta scan's group_k bucketing need."""
         pending: Dict[Tuple[int, int], set] = {}
         for rows in extra_rows:
             fold_multi_group_rows(pending, rows)
-        # Only groups this batch actually resolves matter: the cached scan
-        # compacts columns per *batch* multi op, so untargeted groups can
-        # grow past the cap without affecting correctness.
-        return any(
-            len(ops | self._multi_groups.get(key, set())) > cap
-            for key, ops in pending.items()
+        return max(
+            (
+                len(ops | self._multi_groups.get(key, set()))
+                for key, ops in pending.items()
+            ),
+            default=0,
         )
 
     # -- oracle degradation (the CPU fallback after retry exhaustion) --------
@@ -1190,12 +1196,14 @@ class TpuUniverse:
         stream per replica (micromerge.ts:25-30).
 
         Default path: the patch-emitting sorted merge (kernels.
-        merge_step_sorted_patched) — placement rounds for text, a scan over
-        mark rows only, analytic insert/delete records.  Deep batches fall
-        back to the faithful interleaved per-op scan, as does
-        PERITEXT_MERGE_PATH=scan / PERITEXT_PATCH_PATH=scan.  Both emit the
-        same byte-identical reference stream (micromerge dual-path
-        invariant, test/micromerge.ts:84-85).
+        merge_step_sorted_patched) — placement rounds for text, a
+        compact-delta scan over mark rows only, analytic insert/delete
+        records.  PERITEXT_PATCH_PATH=dense forces the full-plane-carry
+        mark scan (the A/B baseline); deep batches fall back to the
+        faithful interleaved per-op scan, as does PERITEXT_MERGE_PATH=scan
+        / PERITEXT_PATCH_PATH=scan.  Every path emits the same
+        byte-identical reference stream (micromerge dual-path invariant,
+        test/micromerge.ts:84-85).
         """
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
@@ -1251,10 +1259,11 @@ class TpuUniverse:
                 pos_list=text_pos_list,
                 restack_on_fallback=False,
             )
+            multi_need = self._multi_group_need(mark_rows_list)
             if sorted_prep["fell_back"]:
                 use_scan = True
                 self.stats["scan_fallbacks"] += 1
-            elif self._multi_group_overflow(mark_rows_list, K.PATCH_GROUP_K):
+            elif multi_need > K.PATCH_GROUP_K:
                 # The cached patch scan resolves allowMultiple groups over
                 # at most PATCH_GROUP_K compacted columns; a larger group
                 # must take the exact interleaved path.
@@ -1270,6 +1279,7 @@ class TpuUniverse:
                 mark_rows_list,
                 mark_pos_list,
                 group_sizes,
+                multi_need,
             )
         return self._patched_scan(prep, host_patches_for, group_sizes, max_rows)
 
@@ -1356,13 +1366,37 @@ class TpuUniverse:
         mark_rows_list,
         mark_pos_list,
         sizes,
+        multi_need: int = 0,
     ):
         """The patch-emitting sorted merge: placement rounds + mark-only
         scan + analytic text records (kernels.merge_step_sorted_patched).
         Record planes are [R, marks, 2C] — only mark rows, not every op —
         so the memory valve matters less, but PERITEXT_PATCH_CHUNK still
-        applies."""
+        applies.
+
+        The mark-row scan runs as the compact-delta variant by default;
+        PERITEXT_PATCH_PATH=dense forces the full-plane-carry variant for
+        A/B (both byte-identical).  ``multi_need`` (the host census's
+        largest targeted allowMultiple group, already gated under
+        PATCH_GROUP_K by the caller) statically sizes the delta scan's
+        group resolution — a batch with no multi ops compiles without the
+        per-step group machinery entirely."""
         groups, group_of = prep["groups"], prep["group_of"]
+        mode = (
+            "dense"
+            if os.environ.get("PERITEXT_PATCH_PATH") == "dense"
+            else "delta"
+        )
+        has_multi = multi_need > 0
+        group_k = bucket_length(multi_need, minimum=1)
+        # The delta scan's carried batch-winner table only needs the LIVE
+        # mark-type registry (pow2-bucketed, like group_k): valid ops'
+        # type ids are < NUM_MARK_TYPES, and the cache plane's padding
+        # types (MAX_MARK_TYPES) pass through its final compose untouched.
+        t_act = min(
+            bucket_length(schema.NUM_MARK_TYPES, minimum=1),
+            schema.MAX_MARK_TYPES,
+        )
 
         mark_pad = bucket_length(
             max(max((m.shape[0] for m in mark_rows_list), default=1), 1)
@@ -1430,6 +1464,10 @@ class TpuUniverse:
                     sorted_prep["maxk"],
                     has_marks=has_marks,
                     wcache_in=None if wc is None else wc[sl],
+                    mode=mode,
+                    group_k=group_k,
+                    has_multi=has_multi,
+                    t_act=t_act,
                 )
                 state_slices.append(st)
                 # Keep the cache on device — reading it back would cost

@@ -26,7 +26,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+    # The 0.4.x check_rep pass has no replication rule for `while`, which
+    # every kernel here loops with — disable it (the vma-era default check
+    # on newer jax handles while fine and stays on).
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f, **kwargs):
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_impl(f, **kwargs)
 
 
 
@@ -241,9 +248,11 @@ def place_text_sp(mesh: Mesh, halo: int, maxk: int):
         oi = shard * c_local + jnp.arange(c_local, dtype=jnp.int32)
         # The initial orig-idx plane is seq-varying only; the loop mixes it
         # with replica-varying data, so align its varying axes up front.
+        # (0.4.x-era shard_map has no varying-axes tracking at all — there
+        # the mix needs no alignment and neither spelling exists.)
         if hasattr(lax, "pcast"):
             oi = lax.pcast(oi, ("replica",), to="varying")
-        else:  # JAX < pcast: pvary is the only spelling
+        elif hasattr(lax, "pvary"):
             oi = lax.pvary(oi, ("replica",))
         carry = (ec, ea, dl, ch, oi, ln)
         carry = lax.fori_loop(

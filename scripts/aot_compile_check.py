@@ -33,7 +33,24 @@ jax.config.update("jax_platforms", "cpu")
 
 from jax.experimental import topologies  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
-from jax import shard_map  # noqa: E402
+try:
+    from jax import shard_map as _shard_map  # noqa: E402  # jax >= 0.8
+except ImportError:  # the shard.py fallback: older jax keeps it experimental
+    from jax.experimental.shard_map import shard_map as _shard_map  # noqa: E402
+
+
+def shard_map(*a, **kw):
+    """Version shim: the replication-check kwarg renamed check_rep ->
+    check_vma across jax releases, and the image's pinned jax moves
+    between rounds — accept either, pass what this jax understands."""
+    try:
+        return _shard_map(*a, **kw)
+    except TypeError:
+        if "check_vma" in kw:
+            kw = dict(kw)
+            kw["check_rep"] = kw.pop("check_vma")
+            return _shard_map(*a, **kw)
+        raise
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 

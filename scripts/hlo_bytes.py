@@ -5,19 +5,35 @@ The honest HBM-traffic floor for a compiled program (PROFILE_r04.md): XLA's
 `cost_analysis()['bytes accessed']` double-counts operands at fusion
 boundaries (3-10x inflation), so instead we sum the OUTPUT sizes of the
 instructions that actually materialize buffers — every instruction in a
-non-fusion computation except the free ones (parameters, tuples,
+materializing computation except the free ones (parameters, tuples,
 get-tuple-element, bitcasts, and the while/conditional wrappers whose
 outputs alias their bodies').  Real traffic is bounded below by one write
 per materialized output (and usually ~2x that, for the reads).
 
-While-loop bodies are counted ONCE (one trip); for the merge kernels the
-honest score therefore uses the static-rounds roofline variant (the loop
-body IS the per-launch work at num_rounds=1, the bench regime), and any
-multi-trip shape must be scaled by the caller.
+Which computations materialize is decided STRUCTURALLY from the call graph
+(ADVICE r5): a computation referenced through a fusion instruction's
+``calls=`` or through any ``to_apply=`` (reduce/sort/scatter comparators
+and map lambdas) executes inside its caller's fusion/reduction and never
+materializes its own buffers — it is excluded, transitively with anything
+it references.  ``body=``/``condition=`` and conditional branch
+computations DO run as real computations whose outputs land in HBM per
+trip, so they stay counted (while bodies ONCE — one trip; for the merge
+kernels the honest score therefore uses the static-rounds roofline variant,
+and any multi-trip shape must be scaled by the caller).  ``call`` targets
+are counted for the same reason the ``call`` wrapper itself is free.
+
+``--name-heuristic`` restores the pre-r6 behavior — exclude computations
+whose NAME starts with ``fused_computation``/``region`` — kept for
+comparing against the r4/r5 scores.  The difference: the heuristic counts
+comparator/lambda computations with other names (e.g. ``%compare.42``,
+``%add.7``) as materializing (tiny skew — their outputs are scalars) and
+would miscount a fusion body that ever received a non-prefixed name; the
+structural rule follows what actually executes.
 
 Usage:
     python scripts/hlo_bytes.py /tmp/hlo_*.txt
-    python scripts/hlo_bytes.py --per-op dump.txt   # top contributors
+    python scripts/hlo_bytes.py --per-op dump.txt          # top contributors
+    python scripts/hlo_bytes.py --name-heuristic dump.txt  # r4/r5-era rule
 """
 from __future__ import annotations
 
@@ -48,7 +64,17 @@ _INSTR_RE = re.compile(
     r"^\s+(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\(?)((?:pred|[suf]\d+|bf16)\[[^=]*?)\s+"
     r"([\w\-]+)\(",
 )
-_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\([^)]*\)\s*->")
+# Greedy param match: computation headers may carry tuple-typed params
+# with nested parens — `[^)]*` would cut there and silently attribute the
+# body's instructions to the previous computation.
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s*->")
+# Called-computation references on an instruction line.  ``kind`` decides
+# whether the target materializes (see module docstring).
+_REF_RE = re.compile(
+    r"\b(to_apply|calls|body|condition|true_computation|false_computation)"
+    r"=%?([\w.\-]+)"
+)
+_BRANCH_RE = re.compile(r"\bbranch_computations=\{([^}]*)\}")
 
 
 def shape_bytes(shapes_text: str) -> int:
@@ -66,14 +92,21 @@ def shape_bytes(shapes_text: str) -> int:
 
 
 def parse(path: str):
-    """Per-computation, per-opcode materialized output bytes."""
+    """Per-computation, per-opcode materialized output bytes + call graph.
+
+    Returns ``(comps, refs)``: byte tallies per computation, and per
+    computation the list of ``(ref_kind, opcode, target)`` references its
+    instructions make (``opcode`` is the referencing instruction's).
+    """
     comps: dict[str, dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    refs: dict[str, list[tuple[str, str, str]]] = defaultdict(list)
     current = None
     with open(path) as f:
         for line in f:
             m = _COMP_RE.match(line)
             if m:
                 current = m.group(1)
+                comps[current]  # register even if it only holds free ops
                 continue
             if current is None:
                 continue
@@ -81,24 +114,59 @@ def parse(path: str):
             if not m:
                 continue
             _, _, shapes, opcode = m.groups()
+            for kind, target in _REF_RE.findall(line):
+                refs[current].append((kind, opcode, target))
+            branches = _BRANCH_RE.search(line)
+            if branches:
+                for target in branches.group(1).split(","):
+                    target = target.strip().lstrip("%")
+                    if target:
+                        refs[current].append(("branch", opcode, target))
             if opcode in _FREE_OPS:
                 continue
             comps[current][opcode] += shape_bytes(shapes)
-    return comps
+    return comps, refs
 
 
-def score(path: str, per_op: bool = False) -> dict:
-    comps = parse(path)
-    # Fusion sub-computations don't materialize (their fusion instruction,
-    # counted in the parent, does).
-    real = {
-        name: ops
-        for name, ops in comps.items()
-        if not name.startswith(("fused_computation", "region"))
-    }
+def _structurally_excluded(comps, refs) -> set:
+    """Computations that never materialize: referenced via a fusion's
+    ``calls=`` or any ``to_apply=``, plus (transitively) everything an
+    excluded computation itself references — a comparator's helper runs
+    inside the same non-materializing context."""
+    excluded = set()
+    for _src, entries in refs.items():
+        for kind, opcode, target in entries:
+            if kind == "to_apply" or (kind == "calls" and opcode == "fusion"):
+                excluded.add(target)
+    frontier = list(excluded)
+    while frontier:
+        name = frontier.pop()
+        for _kind, _opcode, target in refs.get(name, ()):
+            if target not in excluded:
+                excluded.add(target)
+                frontier.append(target)
+    return excluded
+
+
+def score(path: str, per_op: bool = False, name_heuristic: bool = False) -> dict:
+    comps, refs = parse(path)
+    if name_heuristic:
+        # Pre-r6 rule, kept for score comparability (see module docstring).
+        real = {
+            name: ops
+            for name, ops in comps.items()
+            if not name.startswith(("fused_computation", "region"))
+        }
+    else:
+        excluded = _structurally_excluded(comps, refs)
+        real = {
+            name: ops for name, ops in comps.items() if name not in excluded
+        }
+    real = {name: ops for name, ops in real.items() if ops}
     total = sum(sum(ops.values()) for ops in real.values())
     out = {
         "path": path,
+        "rule": "name-heuristic" if name_heuristic else "structural",
         "output_sum_bytes": total,
         "output_sum_gib": round(total / 2**30, 3),
         "computations": {
@@ -119,9 +187,10 @@ def score(path: str, per_op: bool = False) -> dict:
 
 def main() -> None:
     per_op = "--per-op" in sys.argv
+    name_heuristic = "--name-heuristic" in sys.argv
     paths = [a for a in sys.argv[1:] if not a.startswith("--")]
     for p in paths:
-        print(json.dumps(score(p, per_op)))
+        print(json.dumps(score(p, per_op, name_heuristic)))
 
 
 if __name__ == "__main__":

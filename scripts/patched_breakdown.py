@@ -4,9 +4,13 @@
 Times each phase of TpuUniverse.apply_changes_with_patches separately at the
 patched-bench shape: host prepare/encode, device launch, record readback,
 commit + mark-table build, and the per-replica host patch assembly — so the
-4x no-patch vs patched gap can be attributed before optimizing.
+no-patch vs patched gap can be attributed before optimizing.
 
-    python scripts/patched_breakdown.py [R] [ops_per_merge]
+    python scripts/patched_breakdown.py [R] [ops_per_merge] [--path MODE]
+
+``--path delta|dense|both`` selects the mark-row scan variant (default
+``both``: one breakdown per variant over the identical stream — the
+compact-delta vs full-plane A/B in one invocation).
 """
 import os
 import sys
@@ -28,8 +32,17 @@ if os.environ.get("PATCHED_BREAKDOWN_PLATFORM", "cpu") == "cpu":
 
 
 def main() -> int:
-    R = int(sys.argv[1]) if len(sys.argv) > 1 else 64
-    ops_per_merge = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    argv = sys.argv[1:]
+    path = "both"
+    if "--path" in argv:
+        i = argv.index("--path")
+        path = argv[i + 1]
+        del argv[i : i + 2]
+    if path not in ("delta", "dense", "both"):
+        raise SystemExit(f"--path must be delta|dense|both, got {path!r}")
+    args = [a for a in argv if not a.startswith("--")]
+    R = int(args[0]) if len(args) > 0 else 64
+    ops_per_merge = int(args[1]) if len(args) > 1 else 64
     doc_len = 1000
 
     import jax
@@ -91,10 +104,7 @@ def main() -> int:
         uni.apply_changes_with_patches({n: [genesis] for n in names})
         return uni
 
-    build().apply_changes_with_patches({n: list(stream) for n in names})  # warm
-
     orig_launch = K.merge_step_sorted_patched_batch
-    orig_asarray = np.asarray
 
     def timed_launch(*a, **kw):
         t0 = time.perf_counter()
@@ -107,28 +117,36 @@ def main() -> int:
     wrap(TpuUniverse, "_prepare", "host_prepare")
     wrap(TpuUniverse, "_commit", "commit")
     wrap(TpuUniverse, "_batch_mark_op_table", "mark_table")
-    assemble = wrap(U, "assemble_patches_sorted", "assemble_host")
+    wrap(U, "assemble_patches_sorted", "assemble_host")
 
-    # readback = the np.asarray over record dicts inside _patched_sorted;
-    # measured as total minus the other phases (it is the only remaining
-    # bulk step), plus directly below.
-    uni = build()
-    t.clear()
-    start = time.perf_counter()
-    out = uni.apply_changes_with_patches({n: list(stream) for n in names})
-    total = time.perf_counter() - start
+    from peritext_tpu.testing import patch_path_env
+
+    modes = ("delta", "dense") if path == "both" else (path,)
+    for mode in modes:
+        with patch_path_env(None if mode == "delta" else mode):
+            build().apply_changes_with_patches(
+                {n: list(stream) for n in names}
+            )  # warm/compile this variant
+            # readback = the np.asarray over record dicts inside
+            # _patched_sorted; measured as total minus the other phases (it
+            # is the only remaining bulk step).
+            uni = build()
+            t.clear()
+            start = time.perf_counter()
+            out = uni.apply_changes_with_patches({n: list(stream) for n in names})
+            total = time.perf_counter() - start
+
+        n_patches = sum(len(v) for v in out.values())
+        accounted = sum(t.values())
+        print(f"[{mode}] R={R} ops/merge={n_ops} total_ops={R * n_ops} patches={n_patches}")
+        print(f"total          {total * 1e3:9.1f} ms   ops/s={R * n_ops / total:,.0f}")
+        for key in sorted(t, key=t.get, reverse=True):
+            print(f"{key:14s} {t[key] * 1e3:9.1f} ms   {100 * t[key] / total:5.1f}%")
+        print(
+            f"{'other':14s} {(total - accounted) * 1e3:9.1f} ms   "
+            f"{100 * (total - accounted) / total:5.1f}%  (readback np.asarray + glue)"
+        )
     K.merge_step_sorted_patched_batch = orig_launch
-
-    n_patches = sum(len(v) for v in out.values())
-    accounted = sum(t.values())
-    print(f"R={R} ops/merge={n_ops} total_ops={R * n_ops} patches={n_patches}")
-    print(f"total          {total * 1e3:9.1f} ms   ops/s={R * n_ops / total:,.0f}")
-    for key in sorted(t, key=t.get, reverse=True):
-        print(f"{key:14s} {t[key] * 1e3:9.1f} ms   {100 * t[key] / total:5.1f}%")
-    print(
-        f"{'other':14s} {(total - accounted) * 1e3:9.1f} ms   "
-        f"{100 * (total - accounted) / total:5.1f}%  (readback np.asarray + glue)"
-    )
     return 0
 
 

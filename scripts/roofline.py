@@ -74,6 +74,8 @@ def budget() -> None:
 
 def _cost(compiled):
     ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per module
+        ca = ca[0] if ca else {}
     mem = compiled.memory_analysis()
     # Note: ca['optimal_seconds'] is garbage (negative) from the TPU AOT
     # backend; derive times from bytes/flops and public peaks instead.
@@ -224,6 +226,9 @@ def main() -> int:
         report("mark_phase(_sorted_tail) @bench", tail, per_chip_ops)
 
     # --- patched path ----------------------------------------------------
+    # "patched"/"patched_threaded" pin mode="dense" (the r4/r5-comparable
+    # full-plane scan); "patched_delta"/"patched_delta_threaded" score the
+    # compact-delta scan that replaced it as the default.
     if want("patched"):
         from peritext_tpu.schema import allow_multiple_array
 
@@ -232,10 +237,34 @@ def main() -> int:
         mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
         patched = jax.jit(
             lambda st, t, ro, m, rk, b, mu, tp, mp: K.merge_step_sorted_patched_batch(
-                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"]
+                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
+                mode="dense",
             )
         ).lower(st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos).compile()
-        report("merge_step_sorted_patched @bench", patched, per_chip_ops)
+        report("merge_step_sorted_patched @bench (dense)", patched, per_chip_ops)
+
+    if want("patched_delta"):
+        from peritext_tpu.schema import allow_multiple_array
+
+        multi = sds(allow_multiple_array(), repl)
+        tpos = sds(np.zeros(sp["text"].shape[:2], np.int32), row)
+        mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
+        # group_k=4: the host census sizes the delta scan's allowMultiple
+        # resolution per batch; this workload's comment groups are 1-2 ops
+        # (distinct random ids), so 4 is the realistic compiled width (the
+        # dense targets always pay the full PATCH_GROUP_K machinery).
+        patched_d = jax.jit(
+            lambda st, t, ro, m, rk, b, mu, tp, mp: K.merge_step_sorted_patched_batch(
+                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
+                mode="delta", group_k=4, t_act=4,
+            )
+        ).lower(st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos).compile()
+        report(
+            "merge_step_sorted_patched @bench (compact-delta)",
+            patched_d,
+            per_chip_ops,
+            {"group_k": 4, "t_act": 4},
+        )
 
     if want("patched_nomarks"):
         from peritext_tpu.schema import allow_multiple_array
@@ -264,15 +293,40 @@ def main() -> int:
         threaded = jax.jit(
             lambda st, t, ro, m, rk, b, mu, tp, mp, w: K.merge_step_sorted_patched_batch(
                 st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
-                wcache_in=w,
+                wcache_in=w, mode="dense",
             )
         ).lower(
             st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos, wc
         ).compile()
         report(
-            "merge_step_sorted_patched @bench (threaded cache, no init)",
+            "merge_step_sorted_patched @bench (dense, threaded cache, no init)",
             threaded,
             per_chip_ops,
+        )
+
+    if want("patched_delta_threaded"):
+        from peritext_tpu.schema import allow_multiple_array as _ama
+
+        multi = sds(_ama(), repl)
+        tpos = sds(np.zeros(sp["text"].shape[:2], np.int32), row)
+        mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
+        n_types = int(np.asarray(_ama()).shape[0])
+        wc = sds(
+            np.zeros((R, 2 * capacity, n_types, 4), np.int32), row
+        )
+        threaded_d = jax.jit(
+            lambda st, t, ro, m, rk, b, mu, tp, mp, w: K.merge_step_sorted_patched_batch(
+                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
+                wcache_in=w, mode="delta", group_k=4, t_act=4,
+            )
+        ).lower(
+            st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos, wc
+        ).compile()
+        report(
+            "merge_step_sorted_patched @bench (compact-delta, threaded cache)",
+            threaded_d,
+            per_chip_ops,
+            {"group_k": 4, "t_act": 4},
         )
 
     if not want("latency"):

@@ -701,6 +701,47 @@ def test_local_change_rollback_restores_capacity(monkeypatch):
     assert tdoc.get_text_with_formatting(["text"]) == peer.get_text_with_formatting(["text"])
 
 
+def test_failed_local_launch_leaves_census_unfolded(monkeypatch):
+    """ADVICE r5: the local path's allowMultiple census fold must follow
+    _commit's commit-after-launch invariant.  Driven through _apply_rows
+    directly — unlike change(), it has no snapshot/rollback wrapper, so a
+    pre-launch fold would be observable as a permanently overcounted
+    census (each failed retry of the same change inflating the group until
+    the cached patch scan is needlessly gated off)."""
+    import numpy as np
+
+    from peritext_tpu.ops import kernels as K
+    from peritext_tpu.schema import MARK_TYPE_ID
+
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+    tdoc = TpuDoc("author")
+    tdoc.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0, "values": list("base")}]
+    )
+    uni = tdoc._uni
+    before = {k: set(v) for k, v in uni._multi_groups.items()}
+
+    row = np.zeros(K.OP_FIELDS, np.int32)
+    row[K.K_KIND] = K.KIND_MARK
+    row[K.K_CTR] = tdoc.max_op + 1
+    row[K.K_ACT] = tdoc._actor_int
+    row[K.K_MTYPE] = MARK_TYPE_ID["comment"]
+    row[K.K_MATTR] = uni.attrs.intern({"id": "census-gate"})
+    row[K.K_EKIND] = 2  # endOfText: no end anchor needed
+    key = (int(row[K.K_MTYPE]), int(row[K.K_MATTR]))
+
+    faults.install("device_launch:fail=99")
+    with pytest.raises(DeviceLaunchError):
+        tdoc._apply_rows([row])
+    faults.reset()
+    assert uni._multi_groups == before, "failed launch folded the census"
+
+    # The successful application folds it exactly once.
+    tdoc._apply_rows([row])
+    assert uni._multi_groups.get(key) == {(int(row[K.K_CTR]), int(row[K.K_ACT]))}
+
+
 # ---------------------------------------------------------------------------
 # Crash/recovery: checkpoint + log replay
 # ---------------------------------------------------------------------------

@@ -47,3 +47,82 @@ def test_shape_bytes_tuple_and_unknown_dtypes():
     assert hlo_bytes.shape_bytes("bf16[10]") == 20
     # unknown dtype tokens are skipped, not fatal
     assert hlo_bytes.shape_bytes("c64[4]") == 0
+
+
+_CALLGRAPH_SAMPLE = """\
+HloModule jit_loop, entry_computation_layout={()->f32[]}
+
+%compare.42 (a: s32[], b: s32[]) -> pred[] {
+  %a = s32[] parameter(0)
+  %b = s32[] parameter(1)
+  ROOT %lt = pred[] compare(%a, %b), direction=LT
+}
+
+%helper.9 (h: s32[1024]) -> s32[1024] {
+  %h = s32[1024]{0} parameter(0)
+  ROOT %hmul = s32[1024]{0} multiply(%h, %h)
+}
+
+%oddly_named_fusion.3 (p: s32[1024]) -> s32[1024] {
+  %p = s32[1024]{0} parameter(0)
+  %fin = s32[1024]{0} fusion(%p), kind=kLoop, calls=%helper.9
+  ROOT %inner2 = s32[1024]{0} add(%fin, %p)
+}
+
+%body.1 (w: s32[1024]) -> s32[1024] {
+  %w = s32[1024]{0} parameter(0)
+  ROOT %grow = s32[1024]{0} add(%w, %w)
+}
+
+%cond.1 (cw: s32[1024]) -> pred[] {
+  %cw = s32[1024]{0} parameter(0)
+  ROOT %done = pred[] custom-call(%cw), custom_call_target="t"
+}
+
+ENTRY %main.2 (arg: s32[1024]) -> s32[1024] {
+  %arg = s32[1024]{0} parameter(0)
+  %sorted = s32[1024]{0} sort(%arg), dimensions={0}, to_apply=%compare.42
+  %fus = s32[1024]{0} fusion(%sorted), kind=kLoop, calls=%oddly_named_fusion.3
+  ROOT %loop = s32[1024]{0} while(%fus), condition=%cond.1, body=%body.1
+}
+"""
+
+
+def test_structural_rule_follows_call_graph(tmp_path):
+    """ADVICE r5: called computations are excluded by call-graph structure,
+    not name — a non-prefixed fusion body is excluded (transitively, with
+    its nested fusion's body), a sort comparator is excluded, while
+    body/condition computations are counted once."""
+    p = tmp_path / "dump.txt"
+    p.write_text(_CALLGRAPH_SAMPLE)
+    vec = 1024 * 4
+    structural = hlo_bytes.score(str(p))
+    # entry: sort + fusion; while is free.  body.1: add.  cond.1: the
+    # scalar custom-call (pred[] = 1 byte).  Excluded: comparator,
+    # oddly_named_fusion.3 and (transitively) helper.9.
+    assert structural["rule"] == "structural"
+    assert structural["output_sum_bytes"] == 3 * vec + 1
+    assert "oddly_named_fusion.3" not in structural["computations"]
+    assert "helper.9" not in structural["computations"]
+    assert "compare.42" not in structural["computations"]
+    assert "body.1" in structural["computations"]
+
+    # The old name-prefix heuristic miscounts every one of those (none
+    # start with fused_computation/region) — kept behind --name-heuristic
+    # for r4/r5 score comparability.
+    heuristic = hlo_bytes.score(str(p), name_heuristic=True)
+    assert heuristic["rule"] == "name-heuristic"
+    assert heuristic["output_sum_bytes"] == (
+        structural["output_sum_bytes"] + 3 * vec + 1  # fusion body chain + pred
+    )
+
+
+def test_structural_and_heuristic_agree_on_prefixed_fusions(tmp_path):
+    """On dumps whose fusion bodies use the standard names (every r5
+    artifact), the two rules produce the same score."""
+    p = tmp_path / "dump.txt"
+    p.write_text(_SAMPLE)
+    assert (
+        hlo_bytes.score(str(p))["output_sum_bytes"]
+        == hlo_bytes.score(str(p), name_heuristic=True)["output_sum_bytes"]
+    )

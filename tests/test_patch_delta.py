@@ -1,0 +1,272 @@
+"""Compact-delta patched-path differentials (ISSUE 3 tentpole coverage).
+
+The delta mark-row scan (kernels._delta_mark_scan, the default patched
+path) must be indistinguishable from BOTH existing patched paths — the
+dense full-plane-carry sorted scan (PERITEXT_PATCH_PATH=dense) and the
+faithful interleaved per-op scan (PERITEXT_PATCH_PATH=scan) — at the
+byte level: assembled Patch streams, post-merge device planes, spans,
+and the persisted winner cache (a derived-state invariant shared with
+the dense maintenance).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from peritext_tpu.fuzz import (
+    _random_add_mark,
+    _random_delete,
+    _random_insert,
+    _random_remove_mark,
+)
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.oracle import Doc
+from peritext_tpu.testing import generate_docs, patch_path_env
+
+MODES = ("delta", "dense", "scan")
+
+STATE_FIELDS = (
+    "elem_ctr", "elem_act", "deleted", "chars", "bnd_def", "bnd_mask",
+    "mark_ctr", "mark_act", "mark_action", "mark_type", "mark_attr",
+    "length", "mark_count",
+)
+
+
+def _env_mode(mode):
+    # patch_path_env(None) clears every forcing knob -> the delta default.
+    return None if mode == "delta" else mode
+
+
+def _run_mode(stream, mode, replicas=("observer",), batches=None, **uni_kw):
+    batches = batches or {replicas[0]: stream}
+    with patch_path_env(_env_mode(mode)):
+        uni = TpuUniverse(list(replicas), **uni_kw)
+        out = uni.apply_changes_with_patches(batches)
+    planes = {f: np.asarray(getattr(uni.states, f)).copy() for f in STATE_FIELDS}
+    spans = [uni.spans(r) for r in replicas]
+    wcaches = None if uni._wcaches is None else np.asarray(uni._wcaches).copy()
+    return out, planes, spans, wcaches, uni
+
+
+def _assert_all_equal(stream, replicas=("observer",), batches=None, **uni_kw):
+    """Run one delivery through all three patched paths; everything the
+    fleet can observe must be byte-identical."""
+    runs = {
+        m: _run_mode(stream, m, replicas=replicas, batches=batches, **uni_kw)
+        for m in MODES
+    }
+    ref_out, ref_planes, ref_spans, ref_wc, _ = runs["delta"]
+    for m in ("dense", "scan"):
+        out, planes, spans, wc, _ = runs[m]
+        assert out == ref_out, f"patch stream differs: delta vs {m}"
+        for f in STATE_FIELDS:
+            assert (planes[f] == ref_planes[f]).all(), (
+                f"device plane {f} differs: delta vs {m}"
+            )
+        assert spans == ref_spans, f"spans differ: delta vs {m}"
+    # The winner cache is derived state maintained by BOTH sorted paths
+    # (the scan path drops it); the delta derivation must match the dense
+    # stepwise maintenance byte-for-byte.
+    dense_wc = runs["dense"][3]
+    if ref_wc is not None or dense_wc is not None:
+        assert ref_wc is not None and dense_wc is not None
+        assert (ref_wc == dense_wc).all(), "winner cache differs: delta vs dense"
+    return runs
+
+
+def _oracle_stream(stream):
+    oracle = Doc("oracle-observer")
+    patches = []
+    for change in stream:
+        patches.extend(oracle.apply_change(change))
+    return oracle, patches
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_delta_matches_dense_and_scan_random(seed):
+    """Randomized multi-writer streams (multi-op changes, marks inside
+    insert chains, comments, deletes of fresh chars) through all three
+    patched paths, two replicas with different-size batches."""
+    rng = random.Random(seed + 4242)
+    docs, _, initial_change = generate_docs("Delta scan!", 3)
+    stream = [initial_change]
+    comment_history = []
+    for _ in range(12):
+        doc = docs[rng.randrange(3)]
+        for _ in range(rng.randrange(1, 4)):
+            kind = rng.choice(["insert", "insert", "remove", "addMark", "removeMark"])
+            if kind == "insert":
+                op = _random_insert(rng, doc, 4)
+            elif kind == "remove":
+                op = _random_delete(rng, doc)
+            elif kind == "addMark":
+                op = _random_add_mark(rng, doc, comment_history)
+            else:
+                op = _random_remove_mark(rng, doc, comment_history, False)
+            if op is not None:
+                change, _ = doc.change([op])
+                stream.append(change)
+                for other in docs:
+                    if other is not doc:
+                        other.apply_change(change)
+
+    oracle, oracle_patches = _oracle_stream(stream)
+    batches = {"observer": stream, "late": stream[: len(stream) // 2]}
+    runs = _assert_all_equal(stream, replicas=("observer", "late"), batches=batches)
+    out, _, spans, _, _ = runs["delta"]
+    assert out["observer"] == oracle_patches
+    assert spans[0] == oracle.get_text_with_formatting(["text"])
+
+
+def test_delta_matches_on_zero_width_marks():
+    """Zero-width inputs pin the same-slot -> endOfText walk-order edge:
+    the delta scan's analytic anchors/def-timeline must reproduce it."""
+    docs, _, initial_change = generate_docs("ABCDE")
+    doc = docs[0]
+    stream = [initial_change]
+    # Inclusive zero-width (extends to end), non-inclusive zero-width
+    # (lands nowhere), then text growth through both boundary states.
+    for op in (
+        {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 2,
+         "markType": "strong"},
+        {"path": ["text"], "action": "addMark", "startIndex": 3, "endIndex": 3,
+         "markType": "link", "attrs": {"url": "x.example"}},
+        {"path": ["text"], "action": "insert", "index": 3, "values": list("xy")},
+        {"path": ["text"], "action": "removeMark", "startIndex": 1, "endIndex": 4,
+         "markType": "strong"},
+    ):
+        change, _ = doc.change([op])
+        stream.append(change)
+    oracle, oracle_patches = _oracle_stream(stream)
+    runs = _assert_all_equal(stream)
+    assert runs["delta"][0]["observer"] == oracle_patches
+    assert runs["delta"][2][0] == oracle.get_text_with_formatting(["text"])
+
+
+def test_delta_under_cap_multi_group_resolves_exactly():
+    """A multi-op allowMultiple group UNDER the cap exercises the delta
+    scan's host-sized group_k resolution (presence composed from window
+    words + the base plane at the row's root): add/remove/add on one
+    comment id interleaved with rebasing marks and inserts."""
+    docs, _, initial_change = generate_docs("commented delta text", 2)
+    a, b = docs
+    stream = [initial_change]
+    ops = [
+        (a, {"path": ["text"], "action": "addMark", "startIndex": 1, "endIndex": 9,
+             "markType": "comment", "attrs": {"id": "hot"}}),
+        (b, {"path": ["text"], "action": "addMark", "startIndex": 4, "endIndex": 12,
+             "markType": "strong"}),
+        (a, {"path": ["text"], "action": "removeMark", "startIndex": 2, "endIndex": 7,
+             "markType": "comment", "attrs": {"id": "hot"}}),
+        (b, {"path": ["text"], "action": "insert", "index": 5, "values": list("mid")}),
+        (a, {"path": ["text"], "action": "addMark", "startIndex": 3, "endIndex": 10,
+             "markType": "comment", "attrs": {"id": "hot"}}),
+        (b, {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 6,
+             "markType": "comment", "attrs": {"id": "cold"}}),
+    ]
+    for doc, op in ops:
+        change, _ = doc.change([op])
+        stream.append(change)
+        other = b if doc is a else a
+        other.apply_change(change)
+    oracle, oracle_patches = _oracle_stream(stream)
+    runs = _assert_all_equal(stream)
+    assert runs["delta"][0]["observer"] == oracle_patches
+    # The whole stream in ONE batch resolves the 3-op group in a single
+    # launch (group_k=4); split delivery resolves it incrementally through
+    # the threaded cache.  Both already asserted equal to dense/scan above;
+    # now assert the split delivery too.
+    with patch_path_env(None):
+        uni = TpuUniverse(["observer"])
+        split = []
+        for change in stream:
+            split.extend(uni.apply_changes_with_patches({"observer": [change]})["observer"])
+    assert split == oracle_patches
+    assert uni.spans("observer") == oracle.get_text_with_formatting(["text"])
+
+
+def test_delta_over_cap_group_falls_back_to_scan():
+    """An allowMultiple group past PATCH_GROUP_K still routes to the exact
+    interleaved path under the delta default, emitting the oracle's
+    byte-identical stream."""
+    from peritext_tpu.ops import kernels as K
+
+    docs, _, initial_change = generate_docs("overflow delta")
+    doc = docs[0]
+    stream = [initial_change]
+    for i in range(K.PATCH_GROUP_K + 1):
+        action = "addMark" if i % 2 == 0 else "removeMark"
+        change, _ = doc.change(
+            [{"path": ["text"], "action": action, "startIndex": i % 5,
+              "endIndex": 6 + (i % 4), "markType": "comment",
+              "attrs": {"id": "hot"}}]
+        )
+        stream.append(change)
+    oracle, oracle_patches = _oracle_stream(stream)
+    with patch_path_env(None):
+        uni = TpuUniverse(["observer"])
+        out = uni.apply_changes_with_patches({"observer": stream})["observer"]
+    assert uni.stats.get("multi_group_fallbacks", 0) > 0
+    assert out == oracle_patches
+    assert uni.spans("observer") == oracle.get_text_with_formatting(["text"])
+
+
+def test_delta_degrades_byte_identically_under_faults(monkeypatch):
+    """Chaos leg: the delta path under PERITEXT_FAULTS launch failures
+    exhausts its retry budget and degrades to the oracle CPU path — the
+    emitted stream and device plane must still match a fault-free delta
+    control byte-for-byte (and transient failures must be absorbed by the
+    retry policy without degrading at all)."""
+    from peritext_tpu.runtime import faults
+
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    docs, _, genesis = generate_docs("delta under fire", count=2)
+    a, b = docs
+    c1, _ = a.change(
+        [{"path": ["text"], "action": "insert", "index": 3, "values": list("!!")},
+         {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 8,
+          "markType": "strong"},
+         {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 10,
+          "markType": "comment", "attrs": {"id": "chaos"}}]
+    )
+    b.apply_change(c1)
+    stream = [genesis, c1]
+
+    with patch_path_env(None):
+        ctrl = TpuUniverse(["doc1", "doc2"])
+        control = ctrl.apply_changes_with_patches({"doc1": stream, "doc2": stream})
+
+        # Transient failure: absorbed by retries, no degradation.
+        uni_r = TpuUniverse(["doc1", "doc2"])
+        uni_r.apply_changes_with_patches({"doc1": [genesis], "doc2": [genesis]})
+        faults.install("seed=3;device_launch:fail=1")
+        retried = uni_r.apply_changes_with_patches({"doc1": [c1], "doc2": [c1]})
+        faults.reset()
+        assert uni_r.stats["degraded_batches"] == 0
+        assert uni_r.stats["launch_retries"] >= 1
+
+        # Persistent failure: budget exhausts, the oracle completes it.
+        uni_d = TpuUniverse(["doc1", "doc2"])
+        uni_d.apply_changes_with_patches({"doc1": [genesis], "doc2": [genesis]})
+        faults.install("seed=3;device_launch:fail=99")
+        degraded = uni_d.apply_changes_with_patches({"doc1": [c1], "doc2": [c1]})
+        faults.reset()
+        assert uni_d.stats["degraded_batches"] == 1
+
+    # The control ran genesis+c1 in one batch; replay its c1 slice for the
+    # two-batch universes by re-running a two-batch control.
+    with patch_path_env(None):
+        ctrl2 = TpuUniverse(["doc1", "doc2"])
+        ctrl2.apply_changes_with_patches({"doc1": [genesis], "doc2": [genesis]})
+        control2 = ctrl2.apply_changes_with_patches({"doc1": [c1], "doc2": [c1]})
+    assert retried == control2
+    assert degraded == control2
+    for f in STATE_FIELDS:
+        ref = np.asarray(getattr(ctrl2.states, f))
+        assert (np.asarray(getattr(uni_r.states, f)) == ref).all(), f
+        assert (np.asarray(getattr(uni_d.states, f)) == ref).all(), f
+    # The one-batch control's stream is the two-batch control's, re-split:
+    # genesis patches followed by exactly c1's.
+    assert control["doc1"][-len(control2["doc1"]):] == control2["doc1"]
+    assert (ctrl.digests() == ctrl2.digests()).all()

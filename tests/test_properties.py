@@ -3,7 +3,12 @@ adversarially-shrunk inputs — smaller and stranger cases than the fuzzer's
 distribution (index-boundary marks, single-char docs, dense tombstones).
 """
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# Not baked into every round's image; a missing dep must skip this module,
+# not abort the whole suite's collection.
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from peritext_tpu.oracle import Doc, accumulate_patches
 from peritext_tpu.ops import TpuDoc
