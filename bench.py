@@ -14,6 +14,12 @@ publishes no numbers; BASELINE.md).
 The measurement runs in a supervised subprocess: if the default device
 platform (the TPU tunnel) hangs or fails, it retries on CPU so a wedged
 tunnel still yields an honest—if slower—measurement instead of a hang.
+
+Every line the runner prints carries a "telemetry" summary (launch
+attempts/retries, degraded batches, merge-path tallies, traffic bytes —
+runtime/telemetry.py), so the salvage path below — keeping the LAST
+complete JSON line of a killed child — also recovers the telemetry the
+run had accumulated before the relay wedged.
 """
 import os
 import subprocess

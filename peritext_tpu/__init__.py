@@ -24,6 +24,14 @@ def __getattr__(name):
         from peritext_tpu import schema
 
         return schema.ALL_MARKS
+    # Observability facade: `peritext_tpu.obs` IS the telemetry module
+    # (enable/span/counter/snapshot/summary) — loaded lazily; telemetry
+    # itself is dependency-free, but runtime-package import is deferred
+    # for oracle-only users.
+    if name == "obs":
+        from peritext_tpu.runtime import telemetry
+
+        return telemetry
     # Engine classes load lazily so oracle-only users never pay the jax
     # import.
     if name in ("TpuDoc", "TpuUniverse"):
@@ -64,5 +72,6 @@ __all__ = [
     "editor_doc_text",
     "content_pos_from_editor_pos",
     "initialize_docs",
+    "obs",
     "__version__",
 ]

@@ -8,12 +8,36 @@ import os
 import sys
 
 
+def _emit(result: dict) -> None:
+    """Stamp the current telemetry summary and print the JSON line.
+
+    EVERY line this runner prints goes through here, so the salvage path
+    (bench.py keeps the last complete line of a killed child) always
+    recovers the telemetry the run had accumulated by that point —
+    retries, degraded batches, and merge-path tallies survive a wedged
+    relay exactly like the headline number does."""
+    from peritext_tpu.runtime import telemetry
+
+    summary = telemetry.summary()
+    if summary:
+        result["telemetry"] = summary
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
 def main() -> None:
     platform = os.environ.get("PERITEXT_BENCH_PLATFORM")
     if platform:
         import jax
 
         jax.config.update("jax_platforms", platform)
+
+    # Registry-only collection (the counters are launch-level — noise vs
+    # the merge work being measured); PERITEXT_TRACE/PERITEXT_METRICS in
+    # the env additionally activate the tracer / exit dump as usual.
+    from peritext_tpu.runtime import telemetry
+
+    telemetry.enable()
 
     num_replicas = int(os.environ.get("BENCH_REPLICAS", "1024"))
     doc_len = int(os.environ.get("BENCH_DOC_LEN", "1000"))
@@ -79,8 +103,7 @@ def main() -> None:
     # relay wedges during the latency measurement below, the supervisor
     # (bench.py) recovers this line from the killed child's output.  The
     # final print supersedes it (last JSON line wins).
-    print(json.dumps(result))
-    sys.stdout.flush()
+    _emit(result)
 
     # BASELINE's second tracked metric: p50 merge latency @ 10k-char doc.
     try:
@@ -94,8 +117,7 @@ def main() -> None:
     if latency is not None:
         result["p50_merge_latency_ms_10k_doc"] = latency["p50_ms"]
         result["latency_path"] = latency["path"]
-        print(json.dumps(result))
-        sys.stdout.flush()
+        _emit(result)
 
     # Opt-in third metric: the PATCH-EMITTING ingest path (what an editor
     # fleet consumes), end-to-end through the universe API.  BENCH_PATCHES=1
@@ -122,8 +144,7 @@ def main() -> None:
                 result["patched_scan_ops_per_sec"] = round(p_scan["ops_per_sec"], 1)
                 # Salvage point: a BENCH_TIMEOUT kill during the dense leg
                 # must not discard the three legs already measured.
-                print(json.dumps(result))
-                sys.stdout.flush()
+                _emit(result)
                 # The full-plane-carry sorted scan, for the compact-delta
                 # A/B at the single-ingest shape (fleet legs below A/B the
                 # steady state).
@@ -131,8 +152,7 @@ def main() -> None:
                 result["patched_dense_ops_per_sec"] = round(
                     p_dense["ops_per_sec"], 1
                 )
-            print(json.dumps(result))
-            sys.stdout.flush()
+            _emit(result)
         except Exception as err:
             print(f"bench: patched measurement failed: {err}", file=sys.stderr)
         # Editor-fleet steady state (VERDICT r4 item 4): cache-cold vs
@@ -154,8 +174,7 @@ def main() -> None:
             )
             result["warm_vs_no_patch"] = round(fleet["warm_vs_no_patch"], 3)
             result["fleet_path"] = fleet["path"]
-            print(json.dumps(result))
-            sys.stdout.flush()
+            _emit(result)
         except Exception as err:
             print(f"bench: fleet measurement failed: {err}", file=sys.stderr)
         # BENCH_PATCHES=ab: the dense-vs-delta fleet legs in ONE run —
@@ -181,8 +200,7 @@ def main() -> None:
                     result["fleet_delta_vs_dense_warm"] = round(
                         warm / dense["patched_warm_ops_per_sec"], 3
                     )
-                print(json.dumps(result))
-                sys.stdout.flush()
+                _emit(result)
             except Exception as err:
                 print(
                     f"bench: dense fleet A/B measurement failed: {err}",

@@ -561,6 +561,15 @@ def _main() -> None:
             factory: Callable[[str], Any] = TpuDoc
     else:
         factory = Doc
+    if args.chaos:
+        # Chaos runs are self-describing: the registry collects the
+        # mirrored fault tallies (faults.<site>.<key>) plus the resilience
+        # counters, and the run prints one summary line at the end —
+        # PERITEXT_TRACE/PERITEXT_METRICS additionally activate the tracer
+        # and the exit dump as usual.
+        from peritext_tpu.runtime import telemetry
+
+        telemetry.enable()
     try:
         result = fuzz(
             iterations=args.iters,
@@ -577,12 +586,24 @@ def _main() -> None:
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")
         err.save(path)
+        if args.chaos:
+            _print_telemetry_summary()
         print(f"FAILED: {err}; trace written to {path}")
         raise
+    if args.chaos:
+        _print_telemetry_summary()
     print(
         f"ok: {result['iterations']} iterations, final doc length "
         f"{sum(len(s['text']) for s in result['final_spans'])}"
     )
+
+
+def _print_telemetry_summary() -> None:
+    import json
+
+    from peritext_tpu.runtime import telemetry
+
+    print("telemetry: " + json.dumps(telemetry.summary(), sort_keys=True), flush=True)
 
 
 if __name__ == "__main__":

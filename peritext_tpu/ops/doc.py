@@ -31,6 +31,7 @@ import copy
 from peritext_tpu.ids import make_op_id
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import telemetry
 from peritext_tpu.ops.state import index_state, stack_states
 from peritext_tpu.ops.universe import TpuUniverse, _retryable, assemble_patches
 from peritext_tpu.oracle.doc import (
@@ -186,8 +187,11 @@ class TpuDoc:
                 "ops": [],
             }
             patches: List[Patch] = []
-            for input_op in input_ops:
-                patches.extend(self._generate_input_op(change, input_op))
+            with telemetry.span("doc.change", actor=self.actor_id):
+                for input_op in input_ops:
+                    patches.extend(self._generate_input_op(change, input_op))
+            if telemetry.enabled:
+                telemetry.counter("doc.local_changes")
             return change, patches
         except Exception as exc:
             # Backend-side failure (retry exhaustion, an injected fault, or
@@ -198,6 +202,11 @@ class TpuDoc:
             # keep the oracle's behavior and are not rolled back).
             if not _retryable(exc):
                 raise
+            # Local generation retries ride the shared _run_launch policy
+            # (ingest.launch_retries); this counter is the step past it —
+            # budget exhausted, the whole change rolled back.
+            if telemetry.enabled:
+                telemetry.counter("doc.local_gen_rollbacks")
             self.seq = snap["seq"]
             self.max_op = snap["max_op"]
             if snap["clock_entry"] is None:

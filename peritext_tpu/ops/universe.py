@@ -54,6 +54,7 @@ from peritext_tpu.oracle.doc import (
     ops_to_marks,
 )
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import telemetry
 from peritext_tpu.runtime.sync import causal_order
 from peritext_tpu import schema
 from peritext_tpu.schema import allow_multiple_array
@@ -580,20 +581,35 @@ class TpuUniverse:
         for i in range(retries + 1):
             if i:
                 self.stats["launch_retries"] += 1
-                time.sleep(min(backoff * (2 ** (i - 1)), 2.0))
+                sleep_s = min(backoff * (2 ** (i - 1)), 2.0)
+                if telemetry.enabled:
+                    telemetry.counter("ingest.launch_retries")
+                    telemetry.observe("ingest.backoff_seconds", sleep_s)
+                time.sleep(sleep_s)
             t0 = time.monotonic()
             try:
-                result, barrier_leaf = attempt()
-                if needs_barrier or timeout > 0:
-                    faults.fire("device_readback")
-                    np.asarray(barrier_leaf)
-                    if timeout > 0 and time.monotonic() - t0 > timeout:
-                        raise TimeoutError(
-                            f"device launch attempt exceeded the {timeout}s deadline"
-                        )
+                if telemetry.enabled:
+                    telemetry.counter("ingest.launch_attempts")
+                with telemetry.span("ingest.launch_attempt", attempt=i):
+                    result, barrier_leaf = attempt()
+                    if needs_barrier or timeout > 0:
+                        faults.fire("device_readback")
+                        tb = time.monotonic()
+                        np.asarray(barrier_leaf)
+                        if telemetry.enabled:
+                            telemetry.observe(
+                                "ingest.readback_wait_seconds",
+                                time.monotonic() - tb,
+                            )
+                        if timeout > 0 and time.monotonic() - t0 > timeout:
+                            raise TimeoutError(
+                                f"device launch attempt exceeded the {timeout}s deadline"
+                            )
             except Exception as exc:
                 if not _retryable(exc):
                     raise
+                if telemetry.enabled:
+                    telemetry.counter("ingest.launch_failures")
                 last = exc
                 continue
             return result
@@ -842,6 +858,9 @@ class TpuUniverse:
         """
         groups, group_of = prep["groups"], prep["group_of"]
         self.stats["degraded_batches"] += 1
+        if telemetry.enabled:
+            telemetry.counter("ingest.degraded_batches")
+            telemetry.counter("ingest.path.degraded")
         _log.warning(
             "device launch retry budget exhausted; ingesting %d change(s) "
             "via the oracle CPU degradation path",
@@ -1165,6 +1184,23 @@ class TpuUniverse:
         # degraded_batches), so launch/batch ratios are path-independent.
         self.stats["launches"] += 1
         self.stats["dispatch_seconds"] += time.perf_counter() - t_dev
+        if telemetry.enabled:
+            telemetry.counter("ingest.launches")
+            telemetry.counter(
+                "ingest.path.scan" if use_scan else "ingest.path.sorted"
+            )
+            telemetry.counter(
+                "ingest.h2d_bytes",
+                int(
+                    text_ops.nbytes
+                    + mark_ops.nbytes
+                    + bufs.nbytes
+                    + rounds.nbytes
+                ),
+            )
+            telemetry.observe(
+                "ingest.dispatch_seconds", time.perf_counter() - t_dev
+            )
         # Non-patched merges rewrite boundary rows without maintaining the
         # patched path's winner cache.
         self._wcaches = None
@@ -1343,6 +1379,14 @@ class TpuUniverse:
             }
         self.states = new_states
         self.stats["launches"] += len(record_chunks)  # successful chunk launches
+        if telemetry.enabled:
+            telemetry.counter("ingest.launches", len(record_chunks))
+            telemetry.counter("ingest.path.scan")
+            telemetry.counter("ingest.h2d_bytes", int(ops.nbytes))
+            telemetry.counter(
+                "ingest.d2h_bytes",
+                int(sum(v.nbytes for rec in record_chunks for v in rec.values())),
+            )
         # The interleaved path doesn't maintain the winner cache.
         self._wcaches = None
         self._commit(prep)
@@ -1504,6 +1548,24 @@ class TpuUniverse:
             }
         self.states = new_states
         self.stats["launches"] += len(record_chunks)  # successful chunk launches
+        if telemetry.enabled:
+            telemetry.counter("ingest.launches", len(record_chunks))
+            telemetry.counter("ingest.path." + mode)
+            telemetry.counter(
+                "ingest.h2d_bytes",
+                int(
+                    text_ops.nbytes
+                    + mark_ops.nbytes
+                    + bufs.nbytes
+                    + rounds.nbytes
+                    + text_pos.nbytes
+                    + mark_pos.nbytes
+                ),
+            )
+            telemetry.counter(
+                "ingest.d2h_bytes",
+                int(sum(v.nbytes for rec in record_chunks for v in rec.values())),
+            )
         self._wcaches = wcache
         if wcache is not None:
             # ranks() used by this launch reflect the post-_prepare

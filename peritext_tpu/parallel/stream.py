@@ -44,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.state import DocState, make_empty_state
 from peritext_tpu.parallel.mesh import state_sharding
+from peritext_tpu.runtime import telemetry
 from peritext_tpu.schema import allow_multiple_array
 
 
@@ -180,38 +181,49 @@ def stream_merge_sorted(
         return np.concatenate([sl, fill], axis=0)
 
     def launch(lo: int):
+        # The launch span covers H2D device_put + async dispatch only; the
+        # matching drain span covers the D2H readback barrier.  In a trace,
+        # launch spans overlapping earlier cohorts' drain spans IS the
+        # pipeline overlap the depth>1 design claims.
         hi = min(lo + cohort, r_total)
-        st = jax.tree.map(lambda a: pad(a, lo, hi), host_states)
-        st_d = (
-            jax.tree.map(jax.device_put, st, state_shd)
-            if state_shd is not None
-            else jax.tree.map(jax.device_put, st)
-        )
-        puts = [
-            jax.device_put(pad(a, lo, hi), ops_shd)
-            for a in (text_ops, round_of, mark_ops, char_buf)
-        ]
-        out, dg = step(st_d, puts[0], puts[1], nr, puts[2], ranks_d, puts[3], multi_d)
+        with telemetry.span("stream.launch", lo=lo, hi=hi):
+            st = jax.tree.map(lambda a: pad(a, lo, hi), host_states)
+            st_d = (
+                jax.tree.map(jax.device_put, st, state_shd)
+                if state_shd is not None
+                else jax.tree.map(jax.device_put, st)
+            )
+            puts = [
+                jax.device_put(pad(a, lo, hi), ops_shd)
+                for a in (text_ops, round_of, mark_ops, char_buf)
+            ]
+            out, dg = step(
+                st_d, puts[0], puts[1], nr, puts[2], ranks_d, puts[3], multi_d
+            )
         return lo, hi, out, dg
 
     def drain(entry):
         lo, hi, out, dg = entry
-        n = hi - lo
-        digests[lo:hi] = np.asarray(dg)[:n]
-        if out_states is not None:
-            for host_leaf, dev_leaf in zip(
-                jax.tree.leaves(out_states), jax.tree.leaves(out)
-            ):
-                host_leaf[lo:hi] = np.asarray(dev_leaf)[:n]
-        else:
-            # Digest readback above is the completion barrier already.
-            del out
+        with telemetry.span("stream.drain", lo=lo, hi=hi):
+            n = hi - lo
+            digests[lo:hi] = np.asarray(dg)[:n]
+            if out_states is not None:
+                for host_leaf, dev_leaf in zip(
+                    jax.tree.leaves(out_states), jax.tree.leaves(out)
+                ):
+                    host_leaf[lo:hi] = np.asarray(dev_leaf)[:n]
+            else:
+                # Digest readback above is the completion barrier already.
+                del out
 
     inflight: deque = deque()
     n_cohorts = 0
     for lo in range(0, r_total, cohort):
         inflight.append(launch(lo))
         n_cohorts += 1
+        if telemetry.enabled:
+            telemetry.counter("stream.cohorts")
+            telemetry.gauge_max("stream.inflight_max", len(inflight))
         # Keep `depth` cohorts in flight: the next cohort's H2D and merge
         # are dispatched (async) before this readback blocks, so the DMA
         # engines overlap the compute on hardware.

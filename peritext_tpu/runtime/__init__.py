@@ -4,7 +4,7 @@ The host-side control plane of the framework — the equivalents of the
 reference's pubsub.ts / changeQueue.ts / test-merge.ts layer (SURVEY.md §2.4).
 The data plane (batched op application) lives in ``peritext_tpu.ops``.
 """
-from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import faults, telemetry
 from peritext_tpu.runtime.faults import FaultError, FaultPlan
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.pubsub import Publisher
@@ -31,4 +31,5 @@ __all__ = [
     "causal_sort",
     "faults",
     "sync_pair",
+    "telemetry",
 ]

@@ -32,6 +32,7 @@ from peritext_tpu.ops.state import DocState
 from peritext_tpu.ops.universe import TpuUniverse
 from peritext_tpu.oracle.doc import ObjectStore
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import telemetry
 
 import dataclasses
 
@@ -48,6 +49,13 @@ CHECKPOINT_FORMAT = 2
 
 
 def save_universe(uni: TpuUniverse, path: str) -> None:
+    with telemetry.span("checkpoint.save", path=path):
+        _save_universe(uni, path)
+    if telemetry.enabled:
+        telemetry.counter("checkpoint.saves")
+
+
+def _save_universe(uni: TpuUniverse, path: str) -> None:
     # Chaos chokepoint: an injected failure raises before anything is
     # written; the previous generation stays intact (atomic writes below).
     faults.fire("checkpoint_write")
@@ -144,6 +152,14 @@ def _restore_mark_schema(sidecar: Dict[str, Any]) -> None:
 
 
 def load_universe(path: str) -> TpuUniverse:
+    with telemetry.span("checkpoint.restore", path=path):
+        uni = _load_universe(path)
+    if telemetry.enabled:
+        telemetry.counter("checkpoint.restores")
+    return uni
+
+
+def _load_universe(path: str) -> TpuUniverse:
     with open(path + ".json") as f:
         sidecar = json.load(f)
     fmt = sidecar.get("format", 1)
@@ -283,6 +299,8 @@ class CheckpointManager:
                 # unreadable sidecar): log it and fall back a generation —
                 # the change log replays the gap, so an older snapshot only
                 # costs replay time, never data.
+                if telemetry.enabled:
+                    telemetry.counter("checkpoint.corrupt_fallbacks")
                 _log.warning(
                     "checkpoint generation %d unreadable (%s: %s); "
                     "falling back to the previous generation",

@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
+from peritext_tpu.runtime import telemetry
+
 KNOWN_SITES = (
     "device_launch",
     "device_readback",
@@ -192,6 +194,12 @@ class FaultPlan:
     def _stat(self, site: str, key: str, n: int = 1) -> None:
         stats = self.stats.setdefault(site, {k: 0 for k in _STAT_KEYS})
         stats[key] += n
+        # Mirror every landed fault into the telemetry registry
+        # (``faults.<site>.<key>``): seeded chaos runs become
+        # self-describing, and tests assert the two tallies agree exactly
+        # (same seed + call order ⇒ same counts on both planes).
+        if telemetry.enabled:
+            telemetry.counter(f"faults.{site}.{key}", n)
 
     def _rng(self, site: str, stream: str) -> random.Random:
         key = (site, stream)

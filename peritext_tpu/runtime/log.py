@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Mapping
 
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import telemetry
 
 Change = Dict[str, Any]
 Clock = Mapping[str, int]
@@ -27,6 +28,8 @@ class ChangeLog:
         # lost write — it raises *before* any mutation, so the log never
         # holds a half-recorded change.
         faults.fire("log_append")
+        if telemetry.enabled:
+            telemetry.counter("log.appends")
         queue = self._queues.setdefault(change["actor"], [])
         expected = len(queue) + 1
         if change["seq"] != expected:
@@ -43,6 +46,8 @@ class ChangeLog:
         must surface rather than silently drop.
         """
         faults.fire("log_append")
+        if telemetry.enabled:
+            telemetry.counter("log.appends")
         if change["seq"] < 1:
             # Validate before touching the log: a rejected record must not
             # create a phantom actor entry in clock()/missing_changes.

@@ -16,6 +16,7 @@ import threading
 from typing import Callable, Dict, Generic, TypeVar
 
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import telemetry
 
 T = TypeVar("T")
 
@@ -41,6 +42,11 @@ class Publisher(Generic[T]):
         del self._subscribers[key]
 
     def publish(self, sender: str, update: T) -> None:
+        # Delivered vs dropped/duplicated/reordered/held-back: the chaos
+        # outcomes mirror from FaultPlan._stat (faults.pubsub_deliver.*);
+        # this site counts what actually reached callbacks.
+        if telemetry.enabled:
+            telemetry.counter("pubsub.published")
         for key, callback in list(self._subscribers.items()):
             if key == sender:
                 continue
@@ -49,4 +55,6 @@ class Publisher(Generic[T]):
             # per-link network chaos.
             for delivered in faults.filter_stream("pubsub_deliver", [update], stream=key):
                 faults.fire("pubsub_deliver")
+                if telemetry.enabled:
+                    telemetry.counter("pubsub.delivered")
                 callback(delivered)

@@ -19,9 +19,11 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import telemetry
 
 _log = logging.getLogger(__name__)
 _queue_ids = itertools.count()
@@ -60,11 +62,27 @@ class ChangeQueue:
     def enqueue(self, *changes: Any) -> None:
         with self._lock:
             self._changes.extend(changes)
+            depth = len(self._changes)
+        # High-water mark at enqueue time, not just flush time: depth built
+        # up between flushes (a wedged handler) must be visible.
+        if telemetry.enabled:
+            telemetry.gauge_max("queue.depth_max", depth)
 
     def flush(self) -> None:
         with self._flush_lock:
             with self._lock:
                 changes, self._changes = self._changes, []
+            # Depth/latency telemetry only for non-empty flushes — idle
+            # 10ms timer ticks would otherwise drown the histograms — and
+            # only on SUCCESS, so `queue.flush_depth.count ==
+            # queue.flushes` holds even under injected flush failures
+            # (failed attempts show up as queue.reenqueues instead, and
+            # the re-flushed batch counts once when it finally lands).
+            record = telemetry.enabled and bool(changes)
+            if record:
+                depth = len(changes)
+                telemetry.gauge_max("queue.depth_max", depth)
+                t0 = time.perf_counter()
             try:
                 if changes:
                     # Chaos plane: fail/wedge the flush.  Only fired for
@@ -79,12 +97,20 @@ class ChangeQueue:
                     "queue_flush", changes, stream=self._name
                 )
                 self._handle_flush(changes)
+                if record:
+                    telemetry.counter("queue.flushes")
+                    telemetry.observe("queue.flush_depth", depth)
+                    telemetry.observe(
+                        "queue.flush_seconds", time.perf_counter() - t0
+                    )
             except BaseException:
                 # A failed flush must not lose the batch: put the surviving
                 # changes back at the front so a later flush retries them
                 # ahead of anything enqueued meanwhile.
                 with self._lock:
                     self._changes[:0] = changes
+                if record:
+                    telemetry.counter("queue.reenqueues", len(changes))
                 raise
 
     def _tick(self, epoch: int) -> None:

@@ -1,0 +1,509 @@
+"""Process-wide telemetry plane: metrics registry + span tracer.
+
+Until this module existed the only windows into runtime behavior were the
+ad-hoc ``TpuUniverse.stats`` dict, ``FaultPlan.stats`` on the chaos plane,
+and the one-line bench JSON — questions like "how many launches retried",
+"which merge path actually ran", or "did the cohort pipeline overlap" took
+printf archaeology.  This module is the shared substrate every layer
+reports through:
+
+- a **metrics registry**: monotonic counters, last/max gauges, and
+  histograms with fixed log2 buckets (64 buckets; bucket *i* counts values
+  in ``[2**(i-33), 2**(i-32))``, exact over ``[2**-32, 2**30)`` — sub-ns
+  to ~34-year durations — with explicit ``"<=-32"`` / ``">=31"`` overflow
+  buckets at the ends; larger magnitudes belong in counters);
+- a **span tracer**: ``with telemetry.span("ingest.launch"): ...`` records
+  a Chrome trace-event-format *complete event* (``"ph": "X"``) with
+  monotonic microsecond timestamps and the recording thread's id, so
+  nested spans render as flame stacks per thread in Perfetto /
+  chrome://tracing.  Every span also lands in the registry as a
+  ``span.<name>.seconds`` histogram.
+
+Activation
+==========
+
+``PERITEXT_TRACE=<path>`` writes trace events as JSONL (one JSON object
+per line; wrap with ``jq -s . trace.jsonl > trace.json`` for
+chrome://tracing — Perfetto's importer reads the newline-delimited form
+directly).  ``PERITEXT_METRICS=<path>`` dumps a JSON metrics snapshot at
+interpreter exit.  Either env var enables collection at import; tests and
+embedders call :func:`enable` / :func:`disable` / :func:`reset`
+programmatically.
+
+The overhead contract
+=====================
+
+Instrumented call sites sit inside the ingest hot loop, so the DISABLED
+path must be near-free: every site guards on the single module attribute
+:data:`enabled` —
+
+    if telemetry.enabled:
+        telemetry.counter("ingest.launch_retries")
+
+— one attribute check, no call, no allocation, no lock taken.  (The
+module-level helpers also re-check ``enabled`` internally, so unguarded
+sites are merely slower, never wrong.)  ``span()`` when disabled returns a
+shared no-op singleton, so even unguarded ``with telemetry.span(...)``
+allocates nothing.  tests/test_telemetry.py pins both properties.
+
+Enabled, the cost is one small dict update under a lock per event —
+instrumentation is launch-level (per kernel launch / flush / cohort),
+never per-op, so a telemetry-on run stays within a couple percent of
+telemetry-off on the patched-fleet steady state.
+
+Thread safety: all registry mutation happens under one lock (concurrent
+``ChangeQueue`` timer flushes and foreground ingest cannot lose
+increments), and each ``span()`` call returns a fresh span object, so
+nested or cross-thread spans cannot corrupt one another.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# NOTE: `enabled` is deliberately NOT in __all__ — `from telemetry import
+# enabled` would snapshot the flag at import time and make guards
+# permanently dead.  The one correct spelling is the attribute form the
+# docstring prescribes: `telemetry.enabled`.
+__all__ = [
+    "enable",
+    "disable",
+    "reset",
+    "counter",
+    "gauge",
+    "gauge_max",
+    "observe",
+    "span",
+    "snapshot",
+    "summary",
+    "dump_metrics",
+    "flush_trace",
+    "trace_path",
+]
+
+# THE hot-path gate (see the overhead contract above).
+enabled = False
+
+_N_BUCKETS = 64
+_BUCKET_OFFSET = 32  # bucket i counts values v with frexp(v)[1] == i - 32
+
+
+class _Histogram:
+    """Fixed-log2-bucket histogram (+ count/sum/min/max)."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "buckets")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self.buckets = [0] * _N_BUCKETS
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        if value > 0:
+            i = min(_N_BUCKETS - 1, max(0, math.frexp(value)[1] + _BUCKET_OFFSET))
+        else:
+            i = 0  # non-positive values share the smallest bucket
+        self.buckets[i] += 1
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else None,
+            "max": self.vmax if self.count else None,
+            # Keyed by the bucket's upper-bound exponent: a value v landed
+            # in bucket "e" iff 2**(e-1) <= v < 2**e.  The clamped end
+            # buckets say so explicitly — "<=-32" holds everything below
+            # 2**-32 (including non-positive values), ">=31" everything
+            # from 2**30 up — so a snapshot can never silently claim an
+            # out-of-range value sat inside a nominal bucket.
+            "buckets": {
+                (
+                    "<=-32"
+                    if i == 0
+                    else ">=31"
+                    if i == _N_BUCKETS - 1
+                    else str(i - _BUCKET_OFFSET)
+                ): c
+                for i, c in enumerate(self.buckets)
+                if c
+            },
+        }
+
+
+class Registry:
+    """Thread-safe metrics store.  One process-wide instance lives in this
+    module; tests may build private ones."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, _Histogram] = {}
+
+    def counter(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_max(self, name: str, value: float) -> None:
+        with self._lock:
+            cur = self._gauges.get(name)
+            if cur is None or value > cur:
+                self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram()
+            h.observe(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.to_json() for k, h in self._hists.items()},
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+class _Tracer:
+    """Chrome trace-event JSONL writer (buffered, lock-guarded)."""
+
+    _FLUSH_EVERY = 512
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._buf: List[str] = []
+        self._file: Optional[Any] = open(path, "w")
+        self._emit(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {"name": "peritext-tpu"},
+            }
+        )
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, separators=(",", ":"))
+        with self._lock:
+            if self._file is None:
+                return
+            self._buf.append(line)
+            if len(self._buf) >= self._FLUSH_EVERY:
+                self._flush_locked()
+
+    def emit_complete(
+        self,
+        name: str,
+        ts_us: float,
+        dur_us: float,
+        tid: int,
+        args: Optional[Dict[str, Any]],
+    ) -> None:
+        event: Dict[str, Any] = {
+            "name": name,
+            "cat": "peritext",
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": os.getpid(),
+            "tid": tid,
+        }
+        if args:
+            event["args"] = args
+        self._emit(event)
+
+    def _flush_locked(self) -> None:
+        if self._buf and self._file is not None:
+            self._file.write("\n".join(self._buf) + "\n")
+            self._file.flush()
+            self._buf.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+class _NullSpan:
+    """The disabled-path span: a shared, allocation-free no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: Optional[Dict[str, Any]]) -> None:
+        self.name = name
+        self.args = args
+        self._t0 = 0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        t1 = time.perf_counter_ns()
+        # The span may outlive a disable() (e.g. a test tearing down while a
+        # timer-thread flush is mid-span); record into whatever plane is
+        # current — the registry/tracer never become invalid, only unused.
+        _registry.observe("span." + self.name + ".seconds", (t1 - self._t0) / 1e9)
+        tracer = _tracer
+        if tracer is not None:
+            tracer.emit_complete(
+                self.name,
+                self._t0 / 1e3,
+                (t1 - self._t0) / 1e3,
+                threading.get_ident(),
+                self.args,
+            )
+        return False
+
+
+# -- the process-wide plane ---------------------------------------------------
+
+_registry = Registry()
+_tracer: Optional[_Tracer] = None
+_metrics_path: Optional[str] = None
+_config_lock = threading.Lock()
+_atexit_registered = False
+
+
+def counter(name: str, n: int = 1) -> None:
+    """Add ``n`` to a monotonic counter (no-op while disabled)."""
+    if enabled:
+        _registry.counter(name, n)
+
+
+def gauge(name: str, value: float) -> None:
+    """Set a last-value gauge (no-op while disabled)."""
+    if enabled:
+        _registry.gauge(name, value)
+
+
+def gauge_max(name: str, value: float) -> None:
+    """Raise a high-water-mark gauge (no-op while disabled)."""
+    if enabled:
+        _registry.gauge_max(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    """Record a value into a log2-bucket histogram (no-op while disabled)."""
+    if enabled:
+        _registry.observe(name, value)
+
+
+def span(name: str, **args: Any) -> Any:
+    """Context manager timing a region.  Disabled: returns a shared no-op
+    singleton (zero allocation).  Enabled: records a ``span.<name>.seconds``
+    histogram entry and, when tracing, a Chrome complete event."""
+    if not enabled:
+        return _NULL_SPAN
+    return _Span(name, args or None)
+
+
+def snapshot() -> Dict[str, Any]:
+    """Full registry contents: {"counters", "gauges", "histograms"}."""
+    return _registry.snapshot()
+
+
+def summary() -> Dict[str, Any]:
+    """Compact well-known subset for bench lines and chaos-run footers:
+    launch/retry/degradation tallies, merge-path choices, queue depth,
+    traffic bytes, and the mirrored fault counters.  Only keys that saw
+    traffic appear, so the summary stays one short JSON object."""
+    snap = _registry.snapshot()
+    counters, gauges = snap["counters"], snap["gauges"]
+    out: Dict[str, Any] = {}
+    for key, src in (
+        ("launches", "ingest.launches"),
+        ("launch_attempts", "ingest.launch_attempts"),
+        ("launch_retries", "ingest.launch_retries"),
+        ("launch_failures", "ingest.launch_failures"),
+        ("degraded_batches", "ingest.degraded_batches"),
+        ("h2d_bytes", "ingest.h2d_bytes"),
+        ("d2h_bytes", "ingest.d2h_bytes"),
+        ("queue_flushes", "queue.flushes"),
+        ("queue_reenqueues", "queue.reenqueues"),
+        ("pubsub_delivered", "pubsub.delivered"),
+        ("stream_cohorts", "stream.cohorts"),
+        ("checkpoint_corrupt_fallbacks", "checkpoint.corrupt_fallbacks"),
+        ("local_gen_rollbacks", "doc.local_gen_rollbacks"),
+    ):
+        if src in counters:
+            out[key] = counters[src]
+    paths = {
+        name.rsplit(".", 1)[1]: n
+        for name, n in counters.items()
+        if name.startswith("ingest.path.")
+    }
+    if paths:
+        out["merge_path"] = paths
+    if "queue.depth_max" in gauges:
+        out["queue_depth_max"] = gauges["queue.depth_max"]
+    if "stream.inflight_max" in gauges:
+        out["stream_inflight_max"] = gauges["stream.inflight_max"]
+    faults_mirror = {
+        name[len("faults.") :]: n
+        for name, n in counters.items()
+        if name.startswith("faults.")
+    }
+    if faults_mirror:
+        out["faults"] = faults_mirror
+    return out
+
+
+def trace_path() -> Optional[str]:
+    """Path of the active trace file, or None when not tracing."""
+    tracer = _tracer
+    return None if tracer is None else tracer.path
+
+
+def enable(trace: Optional[str] = None, metrics: Optional[str] = None) -> None:
+    """Turn collection on.  ``trace`` opens (truncating) a Chrome trace
+    JSONL file; ``metrics`` schedules a snapshot dump at interpreter exit.
+    Either may be omitted — a bare ``enable()`` collects registry metrics
+    only."""
+    global enabled, _tracer, _metrics_path
+    with _config_lock:
+        if trace:
+            if _tracer is not None and _tracer.path != trace:
+                _tracer.close()
+                _tracer = None
+            if _tracer is None:
+                _tracer = _Tracer(trace)
+        if metrics:
+            _metrics_path = metrics
+        _ensure_atexit_locked()
+        enabled = True
+
+
+def disable() -> None:
+    """Stop collection (registry contents and the trace file are kept —
+    re-enable resumes into them; use :func:`reset` for a pristine plane)."""
+    global enabled
+    enabled = False
+
+
+def reset() -> None:
+    """Back to a pristine, disabled plane: counters cleared, tracer closed,
+    exit dump canceled.  Does NOT re-read the environment (tests own the
+    lifecycle after a reset)."""
+    global enabled, _tracer, _metrics_path
+    with _config_lock:
+        enabled = False
+        if _tracer is not None:
+            _tracer.close()
+            _tracer = None
+        _metrics_path = None
+        _registry.clear()
+
+
+def flush_trace() -> None:
+    """Force buffered trace events to disk (the tracer also flushes every
+    few hundred events and at exit)."""
+    tracer = _tracer
+    if tracer is not None:
+        tracer.flush()
+
+
+def dump_metrics(path: Optional[str] = None) -> Optional[str]:
+    """Write the metrics snapshot (+ summary) as JSON.  Defaults to the
+    ``PERITEXT_METRICS`` path; returns the path written or None."""
+    path = path or _metrics_path
+    if not path:
+        return None
+    payload = snapshot()
+    payload["summary"] = summary()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def _at_exit() -> None:
+    try:
+        if _metrics_path:
+            dump_metrics(_metrics_path)
+    finally:
+        tracer = _tracer
+        if tracer is not None:
+            tracer.flush()
+
+
+def _ensure_atexit_locked() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        atexit.register(_at_exit)
+        _atexit_registered = True
+
+
+def _activate_from_env() -> None:
+    """Import-time activation from PERITEXT_TRACE / PERITEXT_METRICS.
+
+    A bad trace path (missing directory, permissions) must not take the
+    whole product down at import — observability degrades to untraced
+    collection with a warning instead.  Programmatic :func:`enable` still
+    raises, so deliberate callers see the real error."""
+    trace = os.environ.get("PERITEXT_TRACE")
+    metrics = os.environ.get("PERITEXT_METRICS")
+    if not (trace or metrics):
+        return
+    try:
+        enable(trace=trace or None, metrics=metrics or None)
+    except OSError as exc:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "PERITEXT_TRACE=%r unusable (%s); continuing without a tracer",
+            trace,
+            exc,
+        )
+        enable(metrics=metrics or None)
+
+
+_activate_from_env()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Telemetry overhead A/B at the config-6 patched-fleet steady state.
+
+One process, alternating telemetry-off / telemetry-on legs over identical
+streams (best-of-N per arm, warm rounds scored) — the measurement behind
+the CLAUDE.md "Observability" overhead contract (<2% on this shape).
+
+Prints one JSON line.  Defaults to the CPU backend (the sitecustomize
+platform pin means env vars alone cannot select cpu — this script calls
+jax.config.update before first backend use, like every other harness);
+``--platform ambient`` keeps the process default (the relayed TPU when it
+serves — supervise with a timeout, per CLAUDE.md).
+"""
+import argparse
+import json
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--replicas", type=int, default=256)
+    parser.add_argument("--doc-len", type=int, default=1000)
+    parser.add_argument("--ops", type=int, default=64)
+    parser.add_argument("--rounds", type=int, default=4)
+    parser.add_argument("--best-of", type=int, default=3)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--platform", default="cpu",
+        help="jax platform (default cpu; 'ambient' keeps the process default)",
+    )
+    args = parser.parse_args()
+
+    if args.platform != "ambient":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from peritext_tpu.bench.workloads import time_telemetry_overhead_ab
+
+    result = time_telemetry_overhead_ab(
+        num_replicas=args.replicas,
+        doc_len=args.doc_len,
+        ops_per_merge=args.ops,
+        rounds=args.rounds,
+        seed=args.seed,
+        best_of=args.best_of,
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

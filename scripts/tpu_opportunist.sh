@@ -14,6 +14,25 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=tpu_verification
 mkdir -p "$OUT"
+
+# Single-instance guard: two loops sharing $OUT/.steps_done have corrupted
+# step bookkeeping before (a stale loop from a previous round kept marking
+# steps done under the new loop's feet).  Take an exclusive flock on a
+# lockfile for the lifetime of this process — the kernel drops it when the
+# last holder of the fd exits, so no stale-pidfile cleanup is ever needed —
+# and record the pid so a human can find the holder.  Exit loudly if
+# another instance holds it.  Children close fd 9 at spawn (probe/step pass
+# 9>&-): a wedged bench child surviving a SIGKILLed loop must not keep the
+# lock and block the restart.
+LOCK="$OUT/.opportunist.lock"
+exec 9>>"$LOCK"  # append-open: a losing contender must not truncate the holder's pid
+if ! flock -n 9; then
+  echo "tpu_opportunist: another instance is already running" \
+       "(holder pid $(cat "$LOCK" 2>/dev/null || echo '?'); lock $LOCK); refusing to start" >&2
+  exit 1
+fi
+echo $$ >"$LOCK"
+
 DONE="$OUT/.steps_done"
 touch "$DONE"
 DEADLINE=$(( $(date +%s) + ${OPPORTUNIST_BUDGET:-28800} ))
@@ -22,7 +41,7 @@ probe() {
   timeout 120 python3 -c "
 import jax, numpy as np, jax.numpy as jnp
 print(float(np.asarray(jnp.ones((4,4)).sum())), jax.devices()[0].platform)" \
-    2>/dev/null | grep -Eq "16.0 (axon|tpu)"
+    2>/dev/null 9>&- | grep -Eq "16.0 (axon|tpu)"
 }
 
 # step <name> <timeout> <cmd...>: run once, skip if already done.
@@ -30,7 +49,7 @@ step() {
   local name=$1 t=$2; shift 2
   grep -qx "$name" "$DONE" && return 0
   echo "[$(date +%H:%M:%S)] == $name"
-  timeout "$t" "$@" >"$OUT/$name" 2>"$OUT/$name.err"
+  timeout "$t" "$@" >"$OUT/$name" 2>"$OUT/$name.err" 9>&-
   local rc=$?
   if [ $rc -eq 0 ]; then
     echo "$name" >>"$DONE"
