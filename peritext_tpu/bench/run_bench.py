@@ -16,11 +16,17 @@ def _emit(result: dict) -> None:
     recovers the telemetry the run had accumulated by that point —
     retries, degraded batches, and merge-path tallies survive a wedged
     relay exactly like the headline number does."""
-    from peritext_tpu.runtime import telemetry
+    from peritext_tpu.runtime import health, telemetry
 
     summary = telemetry.summary()
     if summary:
         result["telemetry"] = summary
+    # Health-plane summary (breaker states, trip/fastfail/canary tallies)
+    # rides the same salvage contract: present on every line whenever a
+    # PERITEXT_BREAKER plan is active.
+    health_summary = health.summary()
+    if health_summary:
+        result["health"] = health_summary
     print(json.dumps(result))
     sys.stdout.flush()
 

@@ -601,9 +601,12 @@ def _main() -> None:
 def _print_telemetry_summary() -> None:
     import json
 
-    from peritext_tpu.runtime import telemetry
+    from peritext_tpu.runtime import health, telemetry
 
     print("telemetry: " + json.dumps(telemetry.summary(), sort_keys=True), flush=True)
+    health_summary = health.summary()
+    if health_summary:
+        print("health: " + json.dumps(health_summary, sort_keys=True), flush=True)
 
 
 if __name__ == "__main__":

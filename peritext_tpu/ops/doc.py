@@ -31,6 +31,7 @@ import copy
 from peritext_tpu.ids import make_op_id
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import health
 from peritext_tpu.runtime import telemetry
 from peritext_tpu.ops.state import index_state, stack_states
 from peritext_tpu.ops.universe import TpuUniverse, _retryable, assemble_patches
@@ -204,9 +205,16 @@ class TpuDoc:
                 raise
             # Local generation retries ride the shared _run_launch policy
             # (ingest.launch_retries); this counter is the step past it —
-            # budget exhausted, the whole change rolled back.
+            # budget exhausted, the whole change rolled back.  An OPEN
+            # circuit breaker lands here too (local generation never
+            # degrades — the change rolls back and the author retries once
+            # the backend recovers), but spent zero attempts doing so.
             if telemetry.enabled:
                 telemetry.counter("doc.local_gen_rollbacks")
+                if isinstance(
+                    getattr(exc, "cause", None), health.BreakerOpenError
+                ):
+                    telemetry.counter("doc.local_fastfails")
             self.seq = snap["seq"]
             self.max_op = snap["max_op"]
             if snap["clock_entry"] is None:

@@ -54,6 +54,7 @@ from peritext_tpu.oracle.doc import (
     ops_to_marks,
 )
 from peritext_tpu.runtime import faults
+from peritext_tpu.runtime import health
 from peritext_tpu.runtime import telemetry
 from peritext_tpu.runtime.sync import causal_order
 from peritext_tpu import schema
@@ -442,9 +443,13 @@ class TpuUniverse:
             "scan_fallbacks": 0,
             # Resilience counters: extra launch attempts taken (retry
             # policy) and batches that completed on the oracle CPU path
-            # after the retry budget was exhausted.
+            # after the retry budget was exhausted.  "fastfails" counts
+            # launch units an OPEN circuit breaker rejected without any
+            # attempt (distinct from degraded_batches: a fast-failed
+            # ingest ALSO degrades, but spends no retry/timeout budget).
             "launch_retries": 0,
             "degraded_batches": 0,
+            "fastfails": 0,
             # Wall-clock split of apply_changes: host control plane
             # (gate/encode/fuse/pad/commit) vs launch *dispatch*.  JAX
             # dispatch is async — device execution lands on whichever later
@@ -575,45 +580,81 @@ class TpuUniverse:
         is exhausted, raises :class:`DeviceLaunchError` carrying the last
         cause; callers then either degrade to the oracle CPU path or
         propagate with the committed state untouched.
+
+        Health-plane gating (runtime/health.py): with an active
+        ``device_launch`` breaker, an OPEN circuit fast-fails here —
+        DeviceLaunchError with a :class:`health.BreakerOpenError` cause,
+        zero attempts, zero budget spend — so a wedged backend charges
+        each batch only the degrade path's cost.  Half-open admits exactly
+        one canary launch (``retries`` forced to 0); its success closes
+        the circuit, its failure re-opens with a fresh cool-down.  A trip
+        mid-budget stops the remaining retries (they would fast-fail
+        anyway).
         """
+        br = health.breaker("device_launch")
+        decision = health.ALLOW if br is None else br.admit()
+        if decision == health.FASTFAIL:
+            self.stats["fastfails"] = self.stats.get("fastfails", 0) + 1
+            raise DeviceLaunchError(0, health.BreakerOpenError("device_launch"))
         retries, backoff, timeout = _launch_policy()
+        if decision == health.CANARY:
+            retries = 0  # half-open admits exactly ONE probe launch
         last: Optional[BaseException] = None
-        for i in range(retries + 1):
-            if i:
-                self.stats["launch_retries"] += 1
-                sleep_s = min(backoff * (2 ** (i - 1)), 2.0)
-                if telemetry.enabled:
-                    telemetry.counter("ingest.launch_retries")
-                    telemetry.observe("ingest.backoff_seconds", sleep_s)
-                time.sleep(sleep_s)
-            t0 = time.monotonic()
-            try:
-                if telemetry.enabled:
-                    telemetry.counter("ingest.launch_attempts")
-                with telemetry.span("ingest.launch_attempt", attempt=i):
-                    result, barrier_leaf = attempt()
-                    if needs_barrier or timeout > 0:
-                        faults.fire("device_readback")
-                        tb = time.monotonic()
-                        np.asarray(barrier_leaf)
-                        if telemetry.enabled:
-                            telemetry.observe(
-                                "ingest.readback_wait_seconds",
-                                time.monotonic() - tb,
-                            )
-                        if timeout > 0 and time.monotonic() - t0 > timeout:
-                            raise TimeoutError(
-                                f"device launch attempt exceeded the {timeout}s deadline"
-                            )
-            except Exception as exc:
-                if not _retryable(exc):
-                    raise
-                if telemetry.enabled:
-                    telemetry.counter("ingest.launch_failures")
-                last = exc
-                continue
-            return result
-        raise DeviceLaunchError(retries + 1, last) from last
+        attempts = 0
+        try:
+            for i in range(retries + 1):
+                if i:
+                    self.stats["launch_retries"] += 1
+                    sleep_s = min(backoff * (2 ** (i - 1)), 2.0)
+                    if telemetry.enabled:
+                        telemetry.counter("ingest.launch_retries")
+                        telemetry.observe("ingest.backoff_seconds", sleep_s)
+                    time.sleep(sleep_s)
+                t0 = time.monotonic()
+                attempts = i + 1
+                try:
+                    if telemetry.enabled:
+                        telemetry.counter("ingest.launch_attempts")
+                    with telemetry.span("ingest.launch_attempt", attempt=i):
+                        result, barrier_leaf = attempt()
+                        if needs_barrier or timeout > 0:
+                            faults.fire("device_readback")
+                            tb = time.monotonic()
+                            np.asarray(barrier_leaf)
+                            if telemetry.enabled:
+                                telemetry.observe(
+                                    "ingest.readback_wait_seconds",
+                                    time.monotonic() - tb,
+                                )
+                            if timeout > 0 and time.monotonic() - t0 > timeout:
+                                raise TimeoutError(
+                                    f"device launch attempt exceeded the {timeout}s deadline"
+                                )
+                except Exception as exc:
+                    if not _retryable(exc):
+                        raise  # semantic error: no backend-health signal
+                    if telemetry.enabled:
+                        telemetry.counter("ingest.launch_failures")
+                    if br is not None:
+                        br.record_failure()
+                    last = exc
+                    if br is not None and br.state == health.OPEN:
+                        break  # tripped mid-budget: stop burning retries
+                    continue
+                if br is not None:
+                    br.record_success()
+                return result
+            raise DeviceLaunchError(attempts, last) from last
+        except BaseException:
+            # Any verdict-less exit — a semantic error, or a BaseException
+            # (KeyboardInterrupt mid-dispatch) the retry loop never
+            # classifies — must release a held canary slot, or the breaker
+            # would fast-fail forever with no probe able to run.  abandon()
+            # is a no-op when no canary is in flight (record_success /
+            # record_failure already cleared it on classified outcomes).
+            if br is not None:
+                br.abandon()
+            raise
 
     # -- the causal gate (host) --------------------------------------------
 

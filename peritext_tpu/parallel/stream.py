@@ -44,7 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.state import DocState, make_empty_state
 from peritext_tpu.parallel.mesh import state_sharding
-from peritext_tpu.runtime import telemetry
+from peritext_tpu.runtime import faults, health, telemetry
 from peritext_tpu.schema import allow_multiple_array
 
 
@@ -180,47 +180,93 @@ def stream_merge_sorted(
         fill = np.broadcast_to(arr[0:1], (cohort - (hi - lo),) + arr.shape[1:])
         return np.concatenate([sl, fill], axis=0)
 
+    def _record(br, exc: BaseException) -> None:
+        # Transient errors are breaker signal; semantic errors and
+        # BaseExceptions (KeyboardInterrupt mid-sweep) are not — but they
+        # must still release a held canary slot, or the breaker would
+        # fast-fail forever with no probe able to run.
+        if br is not None:
+            if faults.retryable(exc):
+                br.record_failure()
+            else:
+                br.abandon()
+
     def launch(lo: int):
         # The launch span covers H2D device_put + async dispatch only; the
         # matching drain span covers the D2H readback barrier.  In a trace,
         # launch spans overlapping earlier cohorts' drain spans IS the
         # pipeline overlap the depth>1 design claims.
+        #
+        # Health gating: the stream has no oracle degrade path (the whole
+        # point is a population too big to re-apply host-side per cohort),
+        # so an OPEN device_launch breaker fast-fails the sweep immediately
+        # with BreakerOpenError — the caller retries the round once the
+        # circuit recovers.  Outcomes feed the breaker at the honest
+        # barrier: success on drain readback, failure on a launch OR drain
+        # exception.
+        br = health.breaker("device_launch")
+        decision = health.ALLOW if br is None else br.admit()
+        if decision == health.FASTFAIL:
+            raise health.BreakerOpenError("device_launch")
         hi = min(lo + cohort, r_total)
         with telemetry.span("stream.launch", lo=lo, hi=hi):
-            st = jax.tree.map(lambda a: pad(a, lo, hi), host_states)
-            st_d = (
-                jax.tree.map(jax.device_put, st, state_shd)
-                if state_shd is not None
-                else jax.tree.map(jax.device_put, st)
-            )
-            puts = [
-                jax.device_put(pad(a, lo, hi), ops_shd)
-                for a in (text_ops, round_of, mark_ops, char_buf)
-            ]
-            out, dg = step(
-                st_d, puts[0], puts[1], nr, puts[2], ranks_d, puts[3], multi_d
-            )
-        return lo, hi, out, dg
+            try:
+                faults.fire("device_launch")
+                st = jax.tree.map(lambda a: pad(a, lo, hi), host_states)
+                st_d = (
+                    jax.tree.map(jax.device_put, st, state_shd)
+                    if state_shd is not None
+                    else jax.tree.map(jax.device_put, st)
+                )
+                puts = [
+                    jax.device_put(pad(a, lo, hi), ops_shd)
+                    for a in (text_ops, round_of, mark_ops, char_buf)
+                ]
+                out, dg = step(
+                    st_d, puts[0], puts[1], nr, puts[2], ranks_d, puts[3], multi_d
+                )
+            except BaseException as exc:
+                _record(br, exc)
+                raise
+        return lo, hi, out, dg, br, decision
 
     def drain(entry):
-        lo, hi, out, dg = entry
+        lo, hi, out, dg, br, _decision = entry
         with telemetry.span("stream.drain", lo=lo, hi=hi):
             n = hi - lo
-            digests[lo:hi] = np.asarray(dg)[:n]
-            if out_states is not None:
-                for host_leaf, dev_leaf in zip(
-                    jax.tree.leaves(out_states), jax.tree.leaves(out)
-                ):
-                    host_leaf[lo:hi] = np.asarray(dev_leaf)[:n]
-            else:
-                # Digest readback above is the completion barrier already.
-                del out
+            try:
+                faults.fire("device_readback")
+                digests[lo:hi] = np.asarray(dg)[:n]
+                if out_states is not None:
+                    for host_leaf, dev_leaf in zip(
+                        jax.tree.leaves(out_states), jax.tree.leaves(out)
+                    ):
+                        host_leaf[lo:hi] = np.asarray(dev_leaf)[:n]
+                else:
+                    # Digest readback above is the completion barrier already.
+                    del out
+            except BaseException as exc:
+                _record(br, exc)
+                raise
+        if br is not None:
+            br.record_success()
 
     inflight: deque = deque()
     n_cohorts = 0
     for lo in range(0, r_total, cohort):
-        inflight.append(launch(lo))
+        entry = launch(lo)
         n_cohorts += 1
+        if entry[-1] == health.CANARY:
+            # A half-open probe must resolve (drain = the honest readback
+            # barrier) before any further cohort is admitted: its success
+            # closes the circuit for the rest of the sweep, its failure
+            # re-opens — either way the next admit() sees the verdict
+            # instead of fast-failing behind a still-in-flight canary.
+            drain(entry)
+            if telemetry.enabled:
+                telemetry.counter("stream.cohorts")
+            continue
+        inflight.append(entry)
         if telemetry.enabled:
             telemetry.counter("stream.cohorts")
             telemetry.gauge_max("stream.inflight_max", len(inflight))

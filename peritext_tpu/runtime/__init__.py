@@ -4,11 +4,12 @@ The host-side control plane of the framework — the equivalents of the
 reference's pubsub.ts / changeQueue.ts / test-merge.ts layer (SURVEY.md §2.4).
 The data plane (batched op application) lives in ``peritext_tpu.ops``.
 """
-from peritext_tpu.runtime import faults, telemetry
+from peritext_tpu.runtime import faults, health, telemetry
 from peritext_tpu.runtime.faults import FaultError, FaultPlan
+from peritext_tpu.runtime.health import BreakerOpenError, CircuitBreaker, HealthPlan
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.pubsub import Publisher
-from peritext_tpu.runtime.queue import ChangeQueue
+from peritext_tpu.runtime.queue import ChangeQueue, QueueFullError
 from peritext_tpu.runtime.sync import (
     ConvergenceError,
     apply_available,
@@ -19,17 +20,22 @@ from peritext_tpu.runtime.sync import (
 )
 
 __all__ = [
+    "BreakerOpenError",
     "ChangeLog",
+    "ChangeQueue",
+    "CircuitBreaker",
     "ConvergenceError",
     "FaultError",
     "FaultPlan",
+    "HealthPlan",
     "Publisher",
-    "ChangeQueue",
+    "QueueFullError",
     "apply_available",
     "apply_changes",
     "causal_order",
     "causal_sort",
     "faults",
+    "health",
     "sync_pair",
     "telemetry",
 ]

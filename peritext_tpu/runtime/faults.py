@@ -313,8 +313,10 @@ def active() -> Optional[FaultPlan]:
     if not spec:
         return None
     if spec != _env_spec:
-        _env_spec = spec
+        # Parse BEFORE caching the spec: a malformed spec must raise on
+        # every use, not once-then-silently-inject-nothing.
         _env_plan = FaultPlan.from_spec(spec)
+        _env_spec = spec
     return _env_plan
 
 

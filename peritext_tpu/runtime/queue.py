@@ -13,20 +13,87 @@ Robustness contract: a flush whose handler raises (or is failed by the
 no change is ever lost and a later flush republishes in original order.  The
 timer lifecycle is epoch-guarded: ``drop()`` during an in-flight tick cannot
 race a subsequent ``start()`` into leaking a second timer chain.
+
+Admission control (the health plane's memory bound): an unbounded queue lets
+producers pile up work a sick backend cannot drain — under a wedged relay the
+10ms timer re-fails forever while enqueues keep growing the list.  A bound
+(``bound=``, default ``PERITEXT_QUEUE_BOUND``; 0 = unbounded) caps the
+pending depth, with a pluggable backpressure ``policy``
+(``PERITEXT_QUEUE_POLICY``):
+
+- ``block`` (default): ``enqueue`` waits until a flush frees space (an
+  optional ``block_timeout`` raises :class:`QueueFullError` instead of
+  waiting forever).  Lossless; producers feel the backpressure directly.
+- ``coalesce``: per-actor run coalescing — at the bound, *adjacent* pending
+  changes from the same actor collapse into one queue entry (the bound
+  counts entries), so the single-author editor case (one queue per actor —
+  the repo's idiom) stays O(1) entries under a wedged backend while exact
+  global FIFO order is preserved.  Lossless; incompressible interleavings
+  of distinct actors overflow the bound softly (counted).
+- ``shed``: oldest changes are dropped to make room, with telemetry
+  (``queue.shed``) and a warning — bounded memory at the cost of relying on
+  anti-entropy (the durable change log) to redeliver what was shed.
+
+Every policy decision lands in the telemetry registry: ``queue.blocked`` /
+``queue.block_seconds``, ``queue.coalesced`` / ``queue.coalesce_overflow``,
+``queue.shed``, alongside the existing depth/flush metrics.
 """
 from __future__ import annotations
 
 import itertools
 import logging
+import os
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from collections import deque
+from typing import Any, Callable, Deque, List, Optional
 
 from peritext_tpu.runtime import faults
 from peritext_tpu.runtime import telemetry
 
 _log = logging.getLogger(__name__)
 _queue_ids = itertools.count()
+
+_POLICIES = ("block", "coalesce", "shed")
+
+
+class QueueFullError(RuntimeError):
+    """A blocking enqueue exceeded its ``block_timeout`` with the queue
+    still at its bound (the backend is not draining)."""
+
+
+class _Run:
+    """A coalesced run of adjacent changes from one actor (one queue entry)."""
+
+    __slots__ = ("actor", "changes")
+
+    def __init__(self, actor: Any, changes: List[Any]) -> None:
+        self.actor = actor
+        self.changes = changes
+
+
+def _actor_of(entry: Any) -> Any:
+    if isinstance(entry, _Run):
+        return entry.actor
+    if isinstance(entry, dict):
+        return entry.get("actor")
+    return None
+
+
+def _flatten(entries) -> List[Any]:
+    if not any(isinstance(e, _Run) for e in entries):
+        return list(entries)
+    out: List[Any] = []
+    for e in entries:
+        if isinstance(e, _Run):
+            out.extend(e.changes)
+        else:
+            out.append(e)
+    return out
+
+
+def _entry_size(entry: Any) -> int:
+    return len(entry.changes) if isinstance(entry, _Run) else 1
 
 
 class ChangeQueue:
@@ -36,13 +103,19 @@ class ChangeQueue:
         interval: float = 0.01,
         flush_lock: Optional["threading.RLock"] = None,
         name: Optional[str] = None,
+        bound: Optional[int] = None,
+        policy: Optional[str] = None,
+        block_timeout: Optional[float] = None,
     ) -> None:
         # Chaos stream key: each queue gets its own drop/dup/reorder stream
         # (and holdback buffer) so one queue's held-back changes can never
         # surface through another queue's handler.  Deterministic as long as
         # queue construction order is (pass ``name`` to pin it exactly).
         self._name = name if name is not None else f"queue-{next(_queue_ids)}"
-        self._changes: List[Any] = []
+        # Entries (plain changes or coalesced _Runs) + an incrementally
+        # tracked flattened depth, so admission never rescans the queue.
+        self._changes: Deque[Any] = deque()
+        self._depth = 0
         self._handle_flush = handle_flush
         self._interval = interval
         self._timer: Optional[threading.Timer] = None
@@ -53,25 +126,163 @@ class ChangeQueue:
         # timer chains flushing forever.
         self._epoch = 0
         self._lock = threading.Lock()
+        # Signaled whenever a flush pops the queue; blocking enqueues wait
+        # on it.  Shares the state lock, so waiters observe a consistent
+        # depth.
+        self._drained = threading.Condition(self._lock)
+        if bound is None:
+            bound = int(os.environ.get("PERITEXT_QUEUE_BOUND", "0") or 0)
+        self._bound = max(0, bound)
+        if policy is None:
+            policy = os.environ.get("PERITEXT_QUEUE_POLICY", "block")
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"unknown queue policy {policy!r}; known policies: "
+                f"{', '.join(_POLICIES)}"
+            )
+        self._policy = policy
+        self._block_timeout = block_timeout
         # Held across pop+handle so two concurrent flushes (timer thread vs
         # a manual sync) cannot publish one actor's changes out of seq
         # order.  Callers pass a shared reentrant lock (the Editor passes
         # its publisher's); default is a private one.
         self._flush_lock = flush_lock if flush_lock is not None else threading.RLock()
 
+    # -- admission -----------------------------------------------------------
+
     def enqueue(self, *changes: Any) -> None:
-        with self._lock:
-            self._changes.extend(changes)
-            depth = len(self._changes)
+        """Admit a batch under the bound/policy.  Atomic per call: either
+        every change is admitted (one lock hold, FIFO-contiguous) or — the
+        block policy's timeout — none is, so callers can safely retry a
+        QueueFullError without duplicating a half-admitted prefix."""
+        with self._drained:
+            if not self._bound:
+                self._changes.extend(changes)
+                self._depth += len(changes)
+            elif self._policy == "block":
+                self._admit_blocking_locked(changes)
+            elif self._policy == "shed":
+                self._admit_shedding_locked(changes)
+            else:
+                for change in changes:
+                    self._admit_coalescing_locked(change)
+            depth = self._depth
         # High-water mark at enqueue time, not just flush time: depth built
         # up between flushes (a wedged handler) must be visible.
         if telemetry.enabled:
             telemetry.gauge_max("queue.depth_max", depth)
 
+    def _admit_blocking_locked(self, changes: tuple) -> None:
+        """Wait until the whole batch fits (or the queue is empty — a batch
+        larger than the bound must not deadlock; it overflows softly once
+        it is the only occupant).  On timeout, nothing was admitted."""
+        n = len(changes)
+        t0: Optional[float] = None
+        deadline = (
+            None
+            if self._block_timeout is None
+            else time.monotonic() + self._block_timeout
+        )
+        while self._depth > 0 and self._depth + n > self._bound:
+            if t0 is None:
+                t0 = time.perf_counter()
+                if telemetry.enabled:
+                    telemetry.counter("queue.blocked")
+            if deadline is None:
+                self._drained.wait()
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._drained.wait(remaining):
+                    if telemetry.enabled:
+                        telemetry.observe(
+                            "queue.block_seconds", time.perf_counter() - t0
+                        )
+                    raise QueueFullError(
+                        f"queue {self._name} still at bound "
+                        f"{self._bound} after {self._block_timeout}s"
+                    )
+        if t0 is not None and telemetry.enabled:
+            telemetry.observe("queue.block_seconds", time.perf_counter() - t0)
+        self._changes.extend(changes)
+        self._depth += n
+
+    def _admit_shedding_locked(self, changes: tuple) -> None:
+        self._changes.extend(changes)
+        self._depth += len(changes)
+        shed = 0
+        while self._depth > self._bound:
+            shed_n = _entry_size(self._changes.popleft())
+            self._depth -= shed_n
+            shed += shed_n
+        if shed:
+            if telemetry.enabled:
+                telemetry.counter("queue.shed", shed)
+            _log.warning(
+                "change queue %s over bound %d: shed %d oldest "
+                "change(s) (redelivery relies on anti-entropy)",
+                self._name,
+                self._bound,
+                shed,
+            )
+
+    def _compact_runs_locked(self) -> int:
+        """Merge adjacent same-actor entries into runs; returns the number
+        of changes absorbed.  Exact global FIFO is preserved: a run sits at
+        its first change's position and flattens back in order."""
+        merged = 0
+        out: List[Any] = []
+        for e in self._changes:
+            actor = _actor_of(e)
+            prev = out[-1] if out else None
+            if actor is not None and prev is not None and _actor_of(prev) == actor:
+                if not isinstance(prev, _Run):
+                    out[-1] = prev = _Run(actor, [prev])
+                if isinstance(e, _Run):
+                    prev.changes.extend(e.changes)
+                    merged += len(e.changes)
+                else:
+                    prev.changes.append(e)
+                    merged += 1
+            else:
+                out.append(e)
+        self._changes = deque(out)
+        return merged
+
+    def _admit_coalescing_locked(self, change: Any) -> None:
+        self._depth += 1  # coalescing is lossless: depth always grows
+        # The bound caps ENTRIES; runs keep the change count exact.
+        if len(self._changes) < self._bound:
+            self._changes.append(change)
+            return
+        merged = self._compact_runs_locked()
+        actor = _actor_of(change)
+        prev = self._changes[-1] if self._changes else None
+        if actor is not None and prev is not None and _actor_of(prev) == actor:
+            if not isinstance(prev, _Run):
+                self._changes[-1] = prev = _Run(actor, [prev])
+            prev.changes.append(change)
+            merged += 1
+        elif len(self._changes) < self._bound:
+            self._changes.append(change)
+        else:
+            # Incompressible (distinct actors interleaved at the bound):
+            # keep the change anyway — coalesce bounds entries, never
+            # sheds data.
+            self._changes.append(change)
+            if telemetry.enabled:
+                telemetry.counter("queue.coalesce_overflow")
+        if merged and telemetry.enabled:
+            telemetry.counter("queue.coalesced", merged)
+
+    # -- flushing ------------------------------------------------------------
+
     def flush(self) -> None:
         with self._flush_lock:
-            with self._lock:
-                changes, self._changes = self._changes, []
+            with self._drained:
+                entries, self._changes = self._changes, deque()
+                self._depth = 0
+                self._drained.notify_all()
+            changes = _flatten(entries)
             # Depth/latency telemetry only for non-empty flushes — idle
             # 10ms timer ticks would otherwise drown the histograms — and
             # only on SUCCESS, so `queue.flush_depth.count ==
@@ -106,9 +317,14 @@ class ChangeQueue:
             except BaseException:
                 # A failed flush must not lose the batch: put the surviving
                 # changes back at the front so a later flush retries them
-                # ahead of anything enqueued meanwhile.
+                # ahead of anything enqueued meanwhile (changes an enqueue
+                # raced in DURING this failed flush sit behind the popped
+                # batch — FIFO holds across the failure; pinned by
+                # tests/test_faults.py).  Deliberately past the bound: the
+                # batch was admitted once and must not be re-judged.
                 with self._lock:
-                    self._changes[:0] = changes
+                    self._changes.extendleft(reversed(changes))
+                    self._depth += len(changes)
                 if record:
                     telemetry.counter("queue.reenqueues", len(changes))
                 raise
@@ -148,6 +364,13 @@ class ChangeQueue:
         if timer is not None:
             timer.cancel()
 
-    def __len__(self) -> int:
+    def entries(self) -> int:
+        """Pending queue entries (coalesced runs count once — the quantity
+        the ``coalesce`` policy bounds)."""
         with self._lock:
             return len(self._changes)
+
+    def __len__(self) -> int:
+        """Pending changes (coalesced runs count their members)."""
+        with self._lock:
+            return self._depth

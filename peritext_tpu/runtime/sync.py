@@ -12,6 +12,8 @@ from __future__ import annotations
 from collections import deque
 from typing import Any, Dict, List, Sequence
 
+from peritext_tpu.runtime import telemetry
+
 Change = Dict[str, Any]
 
 
@@ -30,9 +32,10 @@ class ConvergenceError(RuntimeError):
         ids = ", ".join(f"{a}@{s}" for a, s in self.pending_ids[:8])
         if len(self.pending_ids) > 8:
             ids += f", ... ({len(self.pending_ids) - 8} more)"
+        actors = len({a for a, _ in self.pending_ids})
         super().__init__(
-            f"apply_changes did not converge; {len(self.pending)} change(s) "
-            f"still pending: [{ids}]"
+            f"apply_changes did not converge; {len(self.pending)} pending "
+            f"(actor, seq) id(s) across {actors} actor(s): [{ids}]"
         )
 
 
@@ -75,6 +78,11 @@ def apply_available(
             exc.applied_patches = patches  # type: ignore[attr-defined]
             exc.unapplied = list(pending)  # type: ignore[attr-defined]
             raise
+    if pending and telemetry.enabled:
+        # Chaotic-delivery accounting: how many causally-unready changes
+        # each gap-tolerant pass handed back (allow_gaps consumers leave
+        # them for a later anti-entropy redelivery).
+        telemetry.counter("sync.deferred", len(pending))
     return patches, list(pending)
 
 

@@ -368,6 +368,11 @@ def summary() -> Dict[str, Any]:
         ("d2h_bytes", "ingest.d2h_bytes"),
         ("queue_flushes", "queue.flushes"),
         ("queue_reenqueues", "queue.reenqueues"),
+        ("queue_shed", "queue.shed"),
+        ("queue_coalesced", "queue.coalesced"),
+        ("queue_blocked", "queue.blocked"),
+        ("sync_deferred", "sync.deferred"),
+        ("health_fastfails", "health.fastfail"),
         ("pubsub_delivered", "pubsub.delivered"),
         ("stream_cohorts", "stream.cohorts"),
         ("checkpoint_corrupt_fallbacks", "checkpoint.corrupt_fallbacks"),
@@ -393,6 +398,13 @@ def summary() -> Dict[str, Any]:
     }
     if faults_mirror:
         out["faults"] = faults_mirror
+    health_mirror = {
+        name[len("health.") :]: n
+        for name, n in counters.items()
+        if name.startswith("health.") and name != "health.fastfail"
+    }
+    if health_mirror:
+        out["health"] = health_mirror
     return out
 
 

@@ -16,6 +16,7 @@ the exact same faults on every run.
 """
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -26,7 +27,14 @@ from peritext_tpu.ops import TpuUniverse
 from peritext_tpu.ops.doc import TpuDoc
 from peritext_tpu.ops.universe import DeviceLaunchError
 from peritext_tpu.oracle import Doc
-from peritext_tpu.runtime import ChangeLog, ChangeQueue, Publisher, apply_changes, faults
+from peritext_tpu.runtime import (
+    ChangeLog,
+    ChangeQueue,
+    Publisher,
+    apply_changes,
+    faults,
+    health,
+)
 from peritext_tpu.runtime.faults import FaultError, FaultPlan
 from peritext_tpu.testing import generate_docs
 
@@ -40,12 +48,17 @@ STATE_FIELDS = (
 @pytest.fixture(autouse=True)
 def _clean_fault_plane(monkeypatch):
     """Every test starts and ends with no process-wide plan, no resilience
-    env overrides, and fast backoff."""
+    env overrides, and fast backoff.  The health plane resets too (a
+    PERITEXT_BREAKER env spec — the CI chaos leg pins one — re-parses with
+    pristine breakers per test, so one test's failure streak can never trip
+    a later test into fast-failing)."""
     faults.reset()
+    health.reset()
     monkeypatch.delenv("PERITEXT_FAULTS", raising=False)
     monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
     yield
     faults.reset()
+    health.reset()
 
 
 def snapshot_control_plane(uni):
@@ -204,6 +217,36 @@ def test_queue_flush_handler_exception_requeues_ahead_of_new_traffic():
     queue.enqueue("c")
     queue.flush()
     assert calls == [["a", "b"], ["a", "b", "c"]]
+
+
+def test_queue_failed_flush_keeps_fifo_across_racing_enqueue():
+    """Regression pin (ISSUE 7 satellite): a flush failed by queue_flush
+    chaos re-enqueues the popped batch at the FRONT, so a change that an
+    enqueue raced in DURING the failed flush must surface AFTER the popped
+    batch — global FIFO holds across a failed-then-retried flush."""
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, name="fifo-regression")
+    queue.enqueue("a", "b")
+    # fire() sleeps the wedge (outside every queue lock) and THEN raises, so
+    # the racing enqueue deterministically lands mid-failed-flush.
+    faults.install("queue_flush:fail=1,wedge=0.3x1")
+    raced = threading.Event()
+
+    def racer():
+        time.sleep(0.05)  # inside the 0.3s wedge window
+        queue.enqueue("c")
+        raced.set()
+
+    t = threading.Thread(target=racer)
+    t.start()
+    with pytest.raises(FaultError):
+        queue.flush()
+    t.join()
+    assert raced.is_set()
+    assert len(queue) == 3  # nothing lost
+    faults.reset()
+    queue.flush()
+    assert flushed == ["a", "b", "c"]  # popped batch first, racer behind it
 
 
 def test_queue_flush_stream_chaos():

@@ -1,0 +1,656 @@
+"""Health-plane suite: circuit breakers, fast-fail ingest, queue admission.
+
+The invariants under test (ISSUE 7 acceptance):
+
+- the breaker state machine trips on consecutive failures / windowed rate,
+  half-opens after a deterministic jittered cool-down (injectable clock),
+  admits exactly one canary, and closes on canary success;
+- while a ``device_launch`` breaker is OPEN, ingest spends ZERO
+  retry/backoff/timeout budget — it fast-fails straight into the oracle
+  degrade path (``ingest.launch_attempts`` frozen, ``health.fastfail``
+  counting) — and after recovery the fleet returns to the device fast path
+  with patches/state byte-identical to a fault-free control run;
+- every ``CircuitBreaker.stats`` increment mirrors into the telemetry
+  registry as ``health.<site>.<key>`` exactly;
+- ``ChangeQueue`` admission control (``PERITEXT_QUEUE_BOUND`` + the
+  block / coalesce / shed policies) keeps depth flat under a wedged
+  backend without ever reordering what it does deliver.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.ops.doc import TpuDoc
+from peritext_tpu.ops.universe import DeviceLaunchError
+from peritext_tpu.runtime import ChangeLog, ChangeQueue, QueueFullError, faults, health, telemetry
+from peritext_tpu.runtime.health import BreakerOpenError, CircuitBreaker, HealthPlan
+from peritext_tpu.runtime.sync import ConvergenceError, apply_changes
+from peritext_tpu.oracle import Doc
+from peritext_tpu.testing import generate_docs
+
+STATE_FIELDS = (
+    "elem_ctr", "elem_act", "deleted", "chars", "bnd_def", "bnd_mask",
+    "mark_ctr", "mark_act", "mark_action", "mark_type", "mark_attr",
+    "length", "mark_count",
+)
+
+
+class FakeClock:
+    """Injectable monotonic clock: tests drive cool-down expiry explicitly."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    """Pristine fault/health/telemetry planes per test, registry collection
+    on (the suite asserts registry counters), fast backoff."""
+    faults.reset()
+    health.reset()
+    telemetry.reset()
+    telemetry.enable()
+    monkeypatch.delenv("PERITEXT_FAULTS", raising=False)
+    monkeypatch.delenv("PERITEXT_BREAKER", raising=False)
+    monkeypatch.delenv("PERITEXT_QUEUE_BOUND", raising=False)
+    monkeypatch.delenv("PERITEXT_QUEUE_POLICY", raising=False)
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    yield
+    faults.reset()
+    health.reset()
+    telemetry.reset()
+
+
+def device_plane(uni):
+    return {f: np.asarray(getattr(uni.states, f)).copy() for f in STATE_FIELDS}
+
+
+def assert_device_planes_equal(a, b):
+    for f in STATE_FIELDS:
+        assert (a[f] == b[f]).all(), f"device plane differs at {f}"
+
+
+def assert_stats_match_registry(br):
+    """Exact FaultPlan-style stats-vs-registry agreement for health.*."""
+    counters = telemetry.snapshot()["counters"]
+    for key, n in br.stats.items():
+        assert counters.get(f"health.{br.site}.{key}", 0) == n, key
+
+
+# ---------------------------------------------------------------------------
+# The breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_spec_parsing():
+    plan = HealthPlan.from_spec(
+        "seed=9;device_launch:threshold=2,window=8,rate=0.5,cooldown=1.5,jitter=0.2"
+    )
+    assert plan.seed == 9
+    br = plan.breaker("device_launch")
+    assert (br.threshold, br.rate, br.cooldown, br.jitter) == (2, 0.5, 1.5, 0.2)
+    assert br._window.maxlen == 8
+    assert plan.breaker("queue_flush") is None  # unconfigured site: no gate
+    with pytest.raises(ValueError, match="bad breaker clause"):
+        HealthPlan.from_spec("device_launch")
+    with pytest.raises(ValueError, match="unknown breaker parameter"):
+        HealthPlan.from_spec("device_launch:explode=1")
+    with pytest.raises(ValueError, match="unknown breaker site"):
+        HealthPlan.from_spec("device_lauch:threshold=1")  # typo: fail loudly
+    with pytest.raises(ValueError, match="rate"):
+        HealthPlan.from_spec("device_launch:rate=0")
+
+
+def test_breaker_consecutive_trip_halfopen_canary_close():
+    clock = FakeClock()
+    br = CircuitBreaker(
+        "device_launch", threshold=2, cooldown=1.0, jitter=0.0, clock=clock
+    )
+    assert br.admit() == health.ALLOW and br.state == health.CLOSED
+    br.record_failure()
+    assert br.state == health.CLOSED  # one failure: below threshold
+    br.record_failure()
+    assert br.state == health.OPEN and br.stats["trips"] == 1
+    # Open: every admit fast-fails until the cool-down elapses.
+    assert br.admit() == health.FASTFAIL
+    assert br.admit() == health.FASTFAIL
+    assert br.cooldown_remaining() == pytest.approx(1.0)
+    clock.advance(0.5)
+    assert br.admit() == health.FASTFAIL
+    clock.advance(0.6)
+    # Half-open: exactly one canary; concurrent admits keep fast-failing.
+    assert br.admit() == health.CANARY
+    assert br.state == health.HALF_OPEN and br.stats["half_opens"] == 1
+    assert br.admit() == health.FASTFAIL
+    br.record_success()
+    assert br.state == health.CLOSED and br.stats["closes"] == 1
+    assert br.admit() == health.ALLOW
+    assert br.stats["fastfails"] == 4
+    assert_stats_match_registry(br)
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["health.breaker.device_launch.state"] == 0
+    assert gauges["health.breaker.state"] == 0
+
+
+def test_breaker_canary_failure_reopens_with_fresh_cooldown():
+    clock = FakeClock()
+    br = CircuitBreaker(
+        "device_launch", threshold=1, cooldown=2.0, jitter=0.0, clock=clock
+    )
+    br.record_failure()
+    assert br.state == health.OPEN
+    clock.advance(2.5)
+    assert br.admit() == health.CANARY
+    br.record_failure()  # the canary dies
+    assert br.state == health.OPEN
+    assert br.stats["canary_failures"] == 1
+    assert br.cooldown_remaining() == pytest.approx(2.0)  # re-armed from now
+    clock.advance(2.5)
+    assert br.admit() == health.CANARY
+    br.record_success()
+    assert br.state == health.CLOSED
+    assert_stats_match_registry(br)
+
+
+def test_breaker_rate_trip_over_rolling_window():
+    """rate=0.5 over window=4: trips once the window is full and half bad,
+    even though no consecutive streak reaches the threshold."""
+    clock = FakeClock()
+    br = CircuitBreaker(
+        "device_launch", threshold=99, window=4, rate=0.5, cooldown=1.0,
+        jitter=0.0, clock=clock,
+    )
+    for ok in (True, False, True):  # window not yet full / rate below
+        br.record_success() if ok else br.record_failure()
+        assert br.state == health.CLOSED
+    br.record_failure()  # window [T,F,T,F]: rate 0.5 >= 0.5 -> trip
+    assert br.state == health.OPEN and br.stats["trips"] == 1
+    # Close via canary: the pre-outage window must not instantly re-trip.
+    clock.advance(1.5)
+    assert br.admit() == health.CANARY
+    br.record_success()
+    assert br.state == health.CLOSED
+    br.record_failure()  # fresh window: one failure alone cannot re-trip
+    assert br.state == health.CLOSED
+
+
+def test_breaker_jitter_is_deterministic_given_seed():
+    def open_until(seed):
+        clock = FakeClock()
+        br = CircuitBreaker(
+            "device_launch", threshold=1, cooldown=10.0, jitter=0.5,
+            clock=clock, seed=seed,
+        )
+        br.record_failure()
+        return br.cooldown_remaining()
+
+    a, b, c = open_until(5), open_until(5), open_until(6)
+    assert a == b  # same seed -> same jitter draw
+    assert a != c  # seed changes the schedule
+    assert 10.0 <= a <= 15.0  # cooldown * (1 + jitter*[0,1))
+
+
+def test_breaker_abandon_releases_canary_without_verdict():
+    clock = FakeClock()
+    br = CircuitBreaker(
+        "device_launch", threshold=1, cooldown=1.0, jitter=0.0, clock=clock
+    )
+    br.record_failure()
+    clock.advance(1.5)
+    assert br.admit() == health.CANARY
+    br.abandon()  # semantic error: no health signal either way
+    assert br.state == health.HALF_OPEN
+    assert br.admit() == health.CANARY  # the slot is free for a re-probe
+    br.record_success()
+    assert br.state == health.CLOSED
+
+
+def test_malformed_env_spec_raises_on_every_use(monkeypatch):
+    """A typo'd PERITEXT_BREAKER must fail loudly on EVERY use — caching
+    the spec before parsing would raise once and then silently gate
+    nothing for the rest of the process."""
+    monkeypatch.setenv("PERITEXT_BREAKER", "device_lauch:threshold=1")
+    health.reset()
+    for _ in range(2):
+        with pytest.raises(ValueError, match="unknown breaker site"):
+            health.breaker("device_launch")
+    with pytest.raises(ValueError, match="cooldown"):
+        HealthPlan.from_spec("device_launch:cooldown=-5")
+    with pytest.raises(ValueError, match="jitter"):
+        HealthPlan.from_spec("device_launch:jitter=-0.1")
+
+
+def test_env_spec_activates_and_guarded_scopes(monkeypatch):
+    monkeypatch.setenv("PERITEXT_BREAKER", "device_launch:threshold=7")
+    health.reset()
+    assert health.breaker("device_launch").threshold == 7
+    assert health.breaker("queue_flush") is None
+    with health.guarded("device_launch:threshold=1"):
+        assert health.breaker("device_launch").threshold == 1
+    assert health.breaker("device_launch").threshold == 7  # env plan restored
+    health.reset()
+    monkeypatch.delenv("PERITEXT_BREAKER")
+    assert health.breaker("device_launch") is None
+
+
+# ---------------------------------------------------------------------------
+# Fast-fail ingest: the wedge-storm acceptance scenario
+# ---------------------------------------------------------------------------
+
+
+def build_universe(text="health plane", count=2):
+    docs, _, genesis = generate_docs(text, count=count)
+    log = ChangeLog()
+    log.record(genesis)
+    uni = TpuUniverse([d.actor_id for d in docs])
+    uni.apply_changes({d.actor_id: [genesis] for d in docs})
+    return docs, log, uni
+
+
+def _author_changes(docs, n):
+    """n sequential mixed changes from docs[0], cross-synced into docs[1]."""
+    changes = []
+    for i in range(n):
+        ops = [
+            {"path": ["text"], "action": "insert", "index": i,
+             "values": list(f"<{i}>")},
+        ]
+        if i % 2:
+            ops.append(
+                {"path": ["text"], "action": "addMark", "startIndex": 0,
+                 "endIndex": 4 + i, "markType": "strong"}
+            )
+        c, _ = docs[0].change(ops)
+        docs[1].apply_change(c)
+        changes.append(c)
+    return changes
+
+
+@pytest.mark.chaos
+def test_wedge_storm_fastfails_then_recovers_byte_identically(monkeypatch):
+    """The acceptance scenario: a seeded device_launch wedge storm (wedge +
+    per-attempt deadline) trips the breaker after `threshold` failed
+    batches; while OPEN every batch completes at oracle-degrade cost alone
+    (launch attempts frozen, no retries, no backoff); after the cool-down a
+    single canary launch closes the circuit and the fleet returns to the
+    device fast path — with every batch's patches and the final
+    planes/digests byte-identical to a fault-free control universe."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "0")
+
+    docs, _, uni = build_universe()
+    ctrl = TpuUniverse(["doc1", "doc2"])
+    _, _, genesis = generate_docs("health plane", count=2)
+    ctrl.apply_changes({"doc1": [genesis], "doc2": [genesis]})
+    changes = _author_changes(docs, 5)
+
+    # Fault-free control run first (the process-wide breaker would otherwise
+    # see the control's successes).
+    control = [
+        ctrl.apply_changes_with_patches({"doc1": [c], "doc2": [c]})
+        for c in changes
+    ]
+
+    clock = FakeClock()
+    plan = health.install(HealthPlan(seed=3, clock=clock))
+    br = plan.site("device_launch", threshold=2, cooldown=5.0, jitter=0.2)
+    # The deadline goes live only now (a cold compile in the warm-up above
+    # would trip it spuriously); the wedge budget is exactly the storm.
+    monkeypatch.setenv("PERITEXT_LAUNCH_TIMEOUT", "0.2")
+    faults.install("device_launch:wedge=0.5x2")
+    telemetry.reset()
+    telemetry.enable()  # count from the start of the storm
+
+    got = []
+    # Batches 1-2: wedged launches miss the 10ms deadline, fail, degrade;
+    # the second trips the breaker.
+    for c in changes[:2]:
+        got.append(uni.apply_changes_with_patches({"doc1": [c], "doc2": [c]}))
+    assert br.state == health.OPEN and br.stats["trips"] == 1
+    counters = telemetry.snapshot()["counters"]
+    assert counters["ingest.launch_attempts"] == 2
+    assert uni.stats["degraded_batches"] == 2
+    assert uni.stats["launch_retries"] == 0
+
+    # Batches 3-4 (breaker OPEN): fast-fail -> degrade.  Cost is bounded by
+    # the oracle path alone: attempts/retries/backoff all frozen.
+    for c in changes[2:4]:
+        got.append(uni.apply_changes_with_patches({"doc1": [c], "doc2": [c]}))
+    counters = telemetry.snapshot()["counters"]
+    assert counters["ingest.launch_attempts"] == 2  # NOT charged
+    assert counters["health.fastfail"] == 2
+    assert counters.get("ingest.launch_retries", 0) == 0
+    assert "ingest.backoff_seconds" not in telemetry.snapshot()["histograms"]
+    assert uni.stats["fastfails"] == 2
+    assert uni.stats["degraded_batches"] == 4
+
+    # The wedge clears; the cool-down elapses; batch 5 is the canary.
+    clock.advance(10.0)
+    got.append(
+        uni.apply_changes_with_patches({"doc1": [changes[4]], "doc2": [changes[4]]})
+    )
+    assert br.state == health.CLOSED
+    assert br.stats == {
+        "fastfails": 2, "trips": 1, "half_opens": 1, "closes": 1,
+        "canary_failures": 0, "successes": 1, "failures": 2,
+    }
+    assert_stats_match_registry(br)
+    counters = telemetry.snapshot()["counters"]
+    assert counters["ingest.launch_attempts"] == 3  # exactly one canary
+    assert uni.stats["degraded_batches"] == 4  # the canary batch did NOT degrade
+
+    # Byte-identity across the degrade -> fast-fail -> recover seam.
+    assert got == control
+    assert_device_planes_equal(device_plane(uni), device_plane(ctrl))
+    assert (uni.digests() == ctrl.digests()).all()
+
+    # Fully recovered: the next batch launches on the device fast path.
+    c6, _ = docs[0].change(
+        [{"path": ["text"], "action": "delete", "index": 0, "count": 2}]
+    )
+    docs[1].apply_change(c6)
+    uni.apply_changes({"doc1": [c6], "doc2": [c6]})
+    assert uni.spans("doc1") == docs[0].get_text_with_formatting(["text"])
+    assert telemetry.snapshot()["counters"]["ingest.launch_attempts"] == 4
+
+
+def test_trip_mid_budget_stops_remaining_retries(monkeypatch):
+    """threshold=2 with retries=5: the second failed attempt trips the
+    breaker and the remaining retries are skipped (they would fast-fail
+    anyway) — the batch degrades after exactly 2 attempts."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "5")
+    docs, _, uni = build_universe()
+    plan = health.install(HealthPlan(clock=FakeClock()))
+    br = plan.site("device_launch", threshold=2, cooldown=9.0, jitter=0.0)
+    faults.install("device_launch:fail=99")
+    telemetry.reset()
+    telemetry.enable()
+    c, _ = docs[0].change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["x"]}]
+    )
+    docs[1].apply_change(c)
+    uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert uni.stats["degraded_batches"] == 1
+    assert uni.stats["launch_retries"] == 1  # one retry, not five
+    assert telemetry.snapshot()["counters"]["ingest.launch_attempts"] == 2
+    assert br.state == health.OPEN
+
+
+def test_fastfail_respects_degrade_off(monkeypatch):
+    """PERITEXT_DEGRADE=0 + open breaker: DeviceLaunchError(attempts=0) with
+    a BreakerOpenError cause, committed state untouched."""
+    monkeypatch.setenv("PERITEXT_DEGRADE", "0")
+    docs, _, uni = build_universe()
+    before = device_plane(uni)
+    plan = health.install(HealthPlan(clock=FakeClock()))
+    br = plan.site("device_launch", threshold=1, cooldown=9.0, jitter=0.0)
+    br.record_failure()  # trip
+    telemetry.reset()
+    telemetry.enable()
+    c, _ = docs[0].change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["x"]}]
+    )
+    docs[1].apply_change(c)
+    with pytest.raises(DeviceLaunchError) as excinfo:
+        uni.apply_changes({"doc1": [c], "doc2": [c]})
+    assert excinfo.value.attempts == 0
+    assert isinstance(excinfo.value.cause, BreakerOpenError)
+    assert_device_planes_equal(device_plane(uni), before)
+    assert telemetry.snapshot()["counters"].get("ingest.launch_attempts", 0) == 0
+
+
+def test_local_generation_fastfails_and_rolls_back():
+    """TpuDoc.change under an OPEN breaker: zero attempts, clean rollback
+    (the actor's stream stays contiguous), and recovery via the canary."""
+    tdoc = TpuDoc("author")
+    genesis, _ = tdoc.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0, "values": list("base")}]
+    )
+    clock = FakeClock()
+    plan = health.install(HealthPlan(clock=clock))
+    br = plan.site("device_launch", threshold=1, cooldown=4.0, jitter=0.0)
+    br.record_failure()  # trip
+    before = (tdoc.seq, tdoc.max_op, dict(tdoc.clock))
+    telemetry.reset()
+    telemetry.enable()
+    with pytest.raises(DeviceLaunchError):
+        tdoc.change(
+            [{"path": ["text"], "action": "insert", "index": 4, "values": ["!"]}]
+        )
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("ingest.launch_attempts", 0) == 0  # no budget spend
+    assert counters["doc.local_fastfails"] == 1
+    assert counters["doc.local_gen_rollbacks"] == 1
+    assert (tdoc.seq, tdoc.max_op, dict(tdoc.clock)) == before
+    # Recovery: the canary change takes the seq the failed one would have.
+    clock.advance(5.0)
+    c, _ = tdoc.change(
+        [{"path": ["text"], "action": "insert", "index": 4, "values": ["!"]}]
+    )
+    assert br.state == health.CLOSED
+    assert c["seq"] == genesis["seq"] + 1
+    peer = Doc("peer")
+    peer.apply_change(genesis)
+    peer.apply_change(c)
+    assert tdoc.get_text_with_formatting(["text"]) == peer.get_text_with_formatting(["text"])
+
+
+def test_canary_slot_released_on_base_exception():
+    """KeyboardInterrupt mid-canary must release the slot (via abandon),
+    not leave the breaker fast-failing forever with no probe able to run."""
+    _, _, uni = build_universe()
+    clock = FakeClock()
+    plan = health.install(HealthPlan(clock=clock))
+    br = plan.site("device_launch", threshold=1, cooldown=1.0, jitter=0.0)
+    br.record_failure()  # trip
+    clock.advance(2.0)
+
+    def attempt():
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        uni._run_launch(attempt)
+    assert br.state == health.HALF_OPEN
+    assert br.admit() == health.CANARY  # the slot is free for a re-probe
+
+
+def test_stream_fastfails_under_open_breaker_and_recovers():
+    """parallel/stream.py: an OPEN breaker fast-fails the cohort sweep with
+    BreakerOpenError (no degrade path at population scale); after the
+    cool-down the first cohort runs as the canary, closes the circuit, and
+    the full sweep completes bit-identically to a breaker-free run."""
+    from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload
+    from peritext_tpu.ops.encode import prepare_sorted_batch
+    from peritext_tpu.parallel.stream import stream_merge_sorted
+
+    replicas = 4
+    workload = make_merge_workload(
+        doc_len=40, ops_per_merge=8, num_streams=2, with_marks=True, seed=3
+    )
+    batch = build_device_batch(workload, replicas, 128, 32)
+    sp = prepare_sorted_batch([batch["text_ops"][r] for r in range(replicas)])
+    states = __import__("jax").tree.map(np.asarray, batch["states"])
+
+    def sweep():
+        return stream_merge_sorted(
+            states, sp["text"], sp["rounds"], sp["num_rounds"],
+            batch["mark_ops"], batch["ranks"], sp["bufs"], sp["maxk"],
+            cohort=2,
+        )
+
+    _, want_digests, _ = sweep()  # breaker-free reference
+
+    clock = FakeClock()
+    plan = health.install(HealthPlan(clock=clock))
+    br = plan.site("device_launch", threshold=1, cooldown=3.0, jitter=0.0)
+    br.record_failure()  # trip
+    with pytest.raises(BreakerOpenError):
+        sweep()
+    assert br.stats["fastfails"] == 1
+    clock.advance(4.0)
+    _, digests, stats = sweep()  # cohort 1 = canary, then normal pipelining
+    assert br.state == health.CLOSED and br.stats["closes"] == 1
+    assert br.stats["successes"] == stats["n_cohorts"]
+    np.testing.assert_array_equal(digests, want_digests)
+    assert_stats_match_registry(br)
+
+
+# ---------------------------------------------------------------------------
+# ChangeQueue admission control
+# ---------------------------------------------------------------------------
+
+
+def test_queue_shed_policy_drops_oldest_with_telemetry(caplog):
+    import logging
+
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, bound=4, policy="shed")
+    with caplog.at_level(logging.WARNING, logger="peritext_tpu.runtime.queue"):
+        queue.enqueue(*range(7))
+    assert len(queue) == 4  # memory stays flat
+    queue.flush()
+    assert flushed == [3, 4, 5, 6]  # oldest shed, order preserved
+    assert telemetry.snapshot()["counters"]["queue.shed"] == 3
+    assert any("shed 3 oldest" in r.message for r in caplog.records)
+
+
+def test_queue_coalesce_policy_bounds_entries_per_actor_run():
+    """The single-author wedged-backend case: entries stay at the bound
+    while every change survives, in exact FIFO order."""
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, bound=2, policy="coalesce")
+    changes = [{"actor": "a", "seq": i} for i in range(1, 9)]
+    queue.enqueue(*changes)
+    assert queue.entries() <= 2  # the bound counts entries
+    assert len(queue) == 8  # ... but no change was lost
+    assert telemetry.snapshot()["counters"]["queue.coalesced"] >= 6
+    queue.flush()
+    assert flushed == changes  # exact global FIFO through the runs
+
+
+def test_queue_coalesce_interleaved_actors_overflow_softly():
+    """Incompressible interleavings (distinct actors at the bound) overflow
+    the entry bound softly — counted, never shed, never reordered."""
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, bound=2, policy="coalesce")
+    changes = [{"actor": "ab"[i % 2], "seq": 1 + i // 2} for i in range(6)]
+    queue.enqueue(*changes)
+    assert len(queue) == 6
+    queue.flush()
+    assert flushed == changes
+    assert telemetry.snapshot()["counters"]["queue.coalesce_overflow"] >= 1
+
+
+def test_queue_block_policy_waits_for_flush():
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, bound=2, policy="block")
+    queue.enqueue("a", "b")
+    started = threading.Event()
+    done = threading.Event()
+
+    def producer():
+        started.set()
+        queue.enqueue("c")  # blocks at the bound until a flush drains
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    started.wait(2.0)
+    time.sleep(0.05)
+    assert not done.is_set()  # genuinely backpressured
+    queue.flush()
+    assert done.wait(2.0)
+    queue.flush()
+    assert flushed == ["a", "b", "c"]
+    counters = telemetry.snapshot()["counters"]
+    assert counters["queue.blocked"] == 1
+
+
+def test_queue_block_timeout_raises_queue_full_admitting_nothing():
+    queue = ChangeQueue(
+        handle_flush=lambda _: None, bound=1, policy="block", block_timeout=0.05
+    )
+    queue.enqueue("a")
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        queue.enqueue("b", "c")  # a BATCH: all-or-nothing admission
+    assert time.monotonic() - t0 >= 0.04
+    # The rejected batch was not half-admitted: a caller retrying the whole
+    # enqueue cannot duplicate a prefix, and nothing of it was lost either.
+    assert len(queue) == 1
+
+
+def test_queue_block_batch_larger_than_bound_admits_when_empty():
+    """A batch bigger than the bound must not deadlock: it waits for the
+    queue to drain fully, then overflows softly (lossless)."""
+    flushed = []
+    queue = ChangeQueue(handle_flush=flushed.extend, bound=2, policy="block")
+    queue.enqueue("a", "b", "c")  # empty queue: admitted as one unit
+    assert len(queue) == 3
+    queue.flush()
+    assert flushed == ["a", "b", "c"]
+
+
+def test_queue_bound_from_env(monkeypatch):
+    monkeypatch.setenv("PERITEXT_QUEUE_BOUND", "3")
+    monkeypatch.setenv("PERITEXT_QUEUE_POLICY", "shed")
+    queue = ChangeQueue(handle_flush=lambda _: None)
+    queue.enqueue(*range(5))
+    assert len(queue) == 3
+    with pytest.raises(ValueError, match="unknown queue policy"):
+        ChangeQueue(handle_flush=lambda _: None, bound=1, policy="bogus")
+
+
+def test_queue_failed_flush_reenqueue_ignores_bound():
+    """A popped batch was admitted once: re-enqueue after a failed flush
+    must never re-judge it against the bound (that would shed or deadlock
+    in-flight data)."""
+    calls = []
+
+    def handler(changes):
+        calls.append(list(changes))
+        if len(calls) == 1:
+            raise RuntimeError("backend down")
+
+    queue = ChangeQueue(handle_flush=handler, bound=2, policy="shed")
+    queue.enqueue("a", "b")
+    with pytest.raises(RuntimeError):
+        queue.flush()
+    assert len(queue) == 2  # nothing lost
+    queue.flush()
+    assert calls[-1] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# Satellites: sync.deferred telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_sync_deferred_counter_and_convergence_error_count():
+    alice = Doc("alice")
+    genesis, _ = alice.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0, "values": list("hi")}]
+    )
+    c2, _ = alice.change(
+        [{"path": ["text"], "action": "insert", "index": 2, "values": ["!"]}]
+    )
+    bob = Doc("bob")
+    # c2 without genesis: causally unready.
+    pending = apply_changes(bob, [c2], allow_gaps=True)
+    assert pending == []
+    assert telemetry.snapshot()["counters"]["sync.deferred"] == 1
+    with pytest.raises(ConvergenceError) as excinfo:
+        apply_changes(bob, [c2])
+    assert "1 pending (actor, seq) id(s) across 1 actor(s)" in str(excinfo.value)
+    assert excinfo.value.pending_ids == [("alice", c2["seq"])]
+    assert telemetry.snapshot()["counters"]["sync.deferred"] == 2
