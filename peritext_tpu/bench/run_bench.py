@@ -137,6 +137,11 @@ def main() -> None:
             result["patched_ops_per_sec"] = round(p["ops_per_sec"], 1)
             result["patched_replicas"] = p["replicas"]
             result["patched_path"] = p["path"]
+            # Per-leg D2H record bytes from the telemetry tally: the
+            # compact readback's target metric (ISSUE 8) — stamped on
+            # every patched leg so A/B runs carry it in one JSON line.
+            if p.get("d2h_bytes") is not None:
+                result["patched_d2h_bytes"] = p["d2h_bytes"]
             # One fresh-universe ingest measures the cache-COLD regime
             # (dominance init included); the editor-fleet steady state is
             # cache-WARM (time_patched_fleet below).
@@ -158,6 +163,14 @@ def main() -> None:
                 result["patched_dense_ops_per_sec"] = round(
                     p_dense["ops_per_sec"], 1
                 )
+                # Compact-vs-planes readback A/B at the single-ingest
+                # shape (same stream; only the record transfer differs).
+                p_planes = time_patched_merge(readback="planes")
+                result["patched_planes_ops_per_sec"] = round(
+                    p_planes["ops_per_sec"], 1
+                )
+                if p_planes.get("d2h_bytes") is not None:
+                    result["patched_planes_d2h_bytes"] = p_planes["d2h_bytes"]
             _emit(result)
         except Exception as err:
             print(f"bench: patched measurement failed: {err}", file=sys.stderr)
@@ -180,6 +193,9 @@ def main() -> None:
             )
             result["warm_vs_no_patch"] = round(fleet["warm_vs_no_patch"], 3)
             result["fleet_path"] = fleet["path"]
+            if fleet.get("warm_d2h_bytes") is not None:
+                result["fleet_cold_d2h_bytes"] = fleet["cold_d2h_bytes"]
+                result["fleet_warm_d2h_bytes"] = fleet["warm_d2h_bytes"]
             _emit(result)
         except Exception as err:
             print(f"bench: fleet measurement failed: {err}", file=sys.stderr)
@@ -210,6 +226,36 @@ def main() -> None:
             except Exception as err:
                 print(
                     f"bench: dense fleet A/B measurement failed: {err}",
+                    file=sys.stderr,
+                )
+            # Compact-vs-planes readback fleet A/B (identical streams,
+            # only the record transfer format differs): the D2H cut and
+            # its throughput effect at the steady state, in the same run.
+            try:
+                from peritext_tpu.bench.workloads import time_patched_fleet
+
+                planes = time_patched_fleet(readback="planes")
+                result["fleet_planes_warm_ops_per_sec"] = round(
+                    planes["patched_warm_ops_per_sec"], 1
+                )
+                if planes.get("warm_d2h_bytes") is not None:
+                    result["fleet_planes_warm_d2h_bytes"] = planes[
+                        "warm_d2h_bytes"
+                    ]
+                    warm_d2h = result.get("fleet_warm_d2h_bytes")
+                    if warm_d2h:
+                        result["fleet_d2h_cut_vs_planes"] = round(
+                            planes["warm_d2h_bytes"] / warm_d2h, 2
+                        )
+                warm = result.get("patched_warm_ops_per_sec")
+                if warm:
+                    result["fleet_compact_vs_planes_warm"] = round(
+                        warm / planes["patched_warm_ops_per_sec"], 3
+                    )
+                _emit(result)
+            except Exception as err:
+                print(
+                    f"bench: planes readback fleet A/B measurement failed: {err}",
                     file=sys.stderr,
                 )
 

@@ -34,7 +34,12 @@ from peritext_tpu.runtime import faults
 from peritext_tpu.runtime import health
 from peritext_tpu.runtime import telemetry
 from peritext_tpu.ops.state import index_state, stack_states
-from peritext_tpu.ops.universe import TpuUniverse, _retryable, assemble_patches
+from peritext_tpu.ops.universe import (
+    TpuUniverse,
+    _patch_readback,
+    _retryable,
+    assemble_patches,
+)
 from peritext_tpu.oracle.doc import (
     ROOT,
     generate_input_op,
@@ -430,17 +435,32 @@ class TpuDoc:
         # but does NOT degrade: on retry exhaustion the DeviceLaunchError
         # propagates to change(), whose snapshot rolls back every
         # control-plane delta staged for this change.
-        def attempt():
-            faults.fire("device_launch")
-            ns, recs = K.apply_ops_patched_jit(
-                state,
-                jax.numpy.asarray(op_rows),
-                jax.numpy.asarray(uni._ranks()),
-                jax.numpy.asarray(allow_multiple_array()),
-            )
-            return (ns, recs), ns.length
+        readback = _patch_readback()
+        span_cap = uni._span_cap
 
-        new_state, records = uni._run_launch(attempt)
+        def make_attempt(rb: str):
+            def attempt():
+                faults.fire("device_launch")
+                ns, recs = K.apply_ops_patched_jit(
+                    state,
+                    jax.numpy.asarray(op_rows),
+                    jax.numpy.asarray(uni._ranks()),
+                    jax.numpy.asarray(allow_multiple_array()),
+                    readback=rb,
+                    span_cap=span_cap,
+                )
+                return (ns, recs), ns.length
+
+            return attempt
+
+        new_state, records = uni._run_launch(make_attempt(readback))
+        if readback == "compact" and uni._span_overflow(
+            [{"mcount": np.asarray(records["mcount"])}], span_cap
+        ):
+            # Same contract as ingest: overflowed span tables re-read this
+            # change's records via the planes format (the kernel call is
+            # pure — identical records recomputed from the same state).
+            new_state, records = uni._run_launch(make_attempt("planes"))
         uni.states = stack_states([new_state])
         # Locally applied mark rows occupy table columns exactly like
         # ingested ones, so they must count toward the allowMultiple group

@@ -426,15 +426,197 @@ def apply_op_patched(state: DocState, op: jax.Array, ranks: jax.Array, multi: ja
     return new_state, record
 
 
-def apply_ops_patched(state: DocState, ops: jax.Array, ranks: jax.Array, multi: jax.Array):
+def _first_k_set(mask, k: int):
+    """Indices of the first ``k`` set positions of a [N] bool vector, in
+    ascending order: one cumsum + ``k`` binary searches (the running count
+    is non-decreasing, so the first position where it reaches j+1 IS the
+    j-th set position).  Scatter-free AND sort-free — lax.top_k lowers to
+    a per-row partial sort that measures ~1.3 s at the bench record shape
+    on CPU; this formulation is two orders of magnitude cheaper and
+    equally TPU-friendly.  Returns (idx [k] i32 clamped into range,
+    ok [k] bool, total i32 — the full set-bit count, for overflow
+    guards)."""
+    n = mask.shape[0]
+    cs = jnp.cumsum(mask.astype(jnp.int32))
+    q = jnp.arange(1, k + 1, dtype=jnp.int32)
+    idx = jnp.searchsorted(cs, q, side="left")
+    ok = q <= cs[n - 1]
+    return jnp.minimum(idx, n - 1).astype(jnp.int32), ok, cs[n - 1]
+
+
+def compact_mark_records(
+    written, during, changed, vis, obj_len, cand_def, span_cap: int, cand_cap: int
+):
+    """Device-side compaction of per-slot mark patch planes into run tables.
+
+    The host's ``_mark_patch_list`` walk consumes the ``[M, 2C]`` planes
+    only through their *emitted spans*: a patch opens at every written
+    DURING slot whose effective marks change, spans to the next written
+    slot's visibleIndex (or objLength), and survives the finishPartialPatch
+    filters (peritext.ts:269-281).  All of that is a closed per-slot
+    predicate, so the spans compact on device and the D2H readback becomes
+    output-proportional: ``[M, span_cap]`` run tables instead of four
+    ``[M, 2C]`` planes (ISSUE 8 tentpole; the event stream must be
+    proportional to the edits, not the document — eg-walker/Collabs make
+    the same argument host-side).
+
+    Cost structure: every written slot is DEFINED in the post-batch
+    boundary plane (anchor writes define their slots; in-range writes
+    require definedness), and defined slots number at most 2x the mark
+    table — the host-census bound behind the static ``cand_cap``.  So the
+    2C axis is left once per replica (``_first_k_set`` over ``cand_def``,
+    one [2C] cumsum shared by ALL mark rows) and everything per-row runs
+    on the tiny compacted [M, cand_cap] candidate axis: gathers, one
+    cumsum, binary searches.  No full-width per-row passes at all.
+
+    ``cand_def`` must be in the SAME slot coordinates as the record
+    planes — true for the sorted patched merge, whose mark records live
+    on final post-placement coordinates.  The interleaved scan's records
+    are per-op-INSTANT (each splice shifts the slot axis), so it passes
+    ``cand_def=None`` and the compaction runs full-width per row instead
+    (two [M, 2C] cumsums) — costlier, but that path is the deep-batch
+    fallback whose asymptotics are already one scan step per op.
+
+    Returns ``(run_start [M, K] i32, run_end [M, K] i32, count [M] i32)``:
+    lanes hold the row's open (written & during & changed) slots in slot
+    order — the walk's emission order — with the finishPartialPatch
+    filters applied per lane (a filtered lane reads ``end <= start``; the
+    host skips it).  ``count`` is the TRUE open-slot count: the host
+    compares it against ``span_cap`` and falls back to the planes readback
+    on overflow, so the cap never silently truncates a patch stream.
+    """
+    m, two_c = written.shape
+    if cand_def is None:
+        # Instant-coordinate planes: the full slot axis is its own
+        # (exact) candidate set.
+        d = two_c
+        cand_total = None
+        w_c = written
+        open_c = written & during & changed
+        vis_c = vis
+    else:
+        d = min(cand_cap, two_c)
+        cand_idx, cand_ok, cand_total = _first_k_set(cand_def, d)
+        gi = jnp.broadcast_to(cand_idx[None, :], (m, d))
+        w_c = jnp.take_along_axis(written, gi, axis=1) & cand_ok[None, :]
+        open_c = (
+            w_c
+            & jnp.take_along_axis(during, gi, axis=1)
+            & jnp.take_along_axis(changed, gi, axis=1)
+        )
+        vis_c = jnp.take_along_axis(vis, gi, axis=1)
+
+    # First span_cap open candidates per row, ascending (candidates are
+    # already in slot order).
+    k = min(span_cap, d)
+    cs_open = jnp.cumsum(open_c.astype(jnp.int32), axis=1)
+    q = jnp.arange(1, k + 1, dtype=jnp.int32)
+    sel = jax.vmap(lambda a: jnp.searchsorted(a, q, side="left"))(cs_open)
+    lane_ok = q[None, :] <= cs_open[:, d - 1 :]
+    sel_c = jnp.minimum(sel, d - 1)
+    start = jnp.take_along_axis(vis_c, sel_c, axis=1)
+
+    # Patch end: the next WRITTEN candidate after the selected slot (the
+    # walk's closing boundary), else objLength.
+    cs_w = jnp.cumsum(w_c.astype(jnp.int32), axis=1)
+    wk = jnp.take_along_axis(cs_w, sel_c, axis=1)
+    nxt = jax.vmap(lambda a, t: jnp.searchsorted(a, t, side="left"))(cs_w, wk + 1)
+    has_nxt = nxt < d
+    end_raw = jnp.where(
+        has_nxt,
+        jnp.take_along_axis(vis_c, jnp.minimum(nxt, d - 1), axis=1),
+        obj_len[:, None],
+    )
+
+    # finishPartialPatch filters (peritext.ts:269-281), per lane: a
+    # filtered lane stores (0, 0) so the host's end > start test skips it.
+    ok = lane_ok & (end_raw > start) & (start < obj_len[:, None])
+    run_start = jnp.where(ok, start, 0)
+    run_end = jnp.where(ok, jnp.minimum(end_raw, obj_len[:, None]), 0)
+    # Self-guard: the host-census bound makes a candidate overflow
+    # impossible (defined slots <= 2x mark table), but if it ever broke,
+    # spans beyond the candidate axis would silently drop — so report a
+    # beyond-cap count instead and let the host's overflow fallback read
+    # the planes.
+    count = cs_open[:, d - 1]
+    if cand_total is not None:
+        count = jnp.where(
+            cand_total > d, jnp.full((m,), span_cap + 1, jnp.int32), count
+        )
+    if span_cap > k:  # degenerate tiny-capacity shape: pad the lanes
+        pad = ((0, 0), (0, span_cap - k))
+        run_start = jnp.pad(run_start, pad)
+        run_end = jnp.pad(run_end, pad)
+    return run_start, run_end, count
+
+
+def apply_ops_patched(
+    state: DocState,
+    ops: jax.Array,
+    ranks: jax.Array,
+    multi: jax.Array,
+    readback: str = "planes",
+    span_cap: int = 8,
+):
     def step(s, op):
         return apply_op_patched(s, op, ranks, multi)
 
-    return lax.scan(step, state, ops)
+    new_state, rec = lax.scan(step, state, ops)
+    if readback != "compact":
+        return new_state, rec
+    # Output-proportional readback: the mark planes compact to run tables
+    # (cand_def=None — the interleaved records are per-op-INSTANT slot
+    # coordinates, see compact_mark_records), and fields the host already
+    # holds in the encoded op rows (kind, the insert payload, obj_len —
+    # compact mark patches carry their own clamped ends) drop from the
+    # record dict entirely.
+    run_start, run_end, count = compact_mark_records(
+        rec["written"], rec["during"], rec["changed"], rec["vis"],
+        rec["obj_len"], None, span_cap, 0,
+    )
+    return new_state, {
+        "index": rec["index"],
+        "valid": rec["valid"],
+        "ins_mask": rec["ins_mask"],
+        "mstart": run_start,
+        "mend": run_end,
+        "mcount": count,
+    }
 
 
-apply_ops_patched_jit = jax.jit(apply_ops_patched)
-apply_ops_patched_batch = jax.jit(jax.vmap(apply_ops_patched, in_axes=(0, 0, None, None)))
+@functools.lru_cache(maxsize=None)
+def _apply_ops_patched_jit(readback: str, span_cap: int):
+    return jax.jit(
+        functools.partial(apply_ops_patched, readback=readback, span_cap=span_cap)
+    )
+
+
+def apply_ops_patched_jit(
+    state, ops, ranks, multi, readback: str = "planes", span_cap: int = 8
+):
+    if readback == "planes":  # cap unused: keep ONE jit cache entry
+        span_cap = 8
+    return _apply_ops_patched_jit(readback, span_cap)(state, ops, ranks, multi)
+
+
+@functools.lru_cache(maxsize=None)
+def _apply_ops_patched_batch(readback: str, span_cap: int):
+    return jax.jit(
+        jax.vmap(
+            functools.partial(
+                apply_ops_patched, readback=readback, span_cap=span_cap
+            ),
+            in_axes=(0, 0, None, None),
+        )
+    )
+
+
+def apply_ops_patched_batch(
+    states, ops, ranks, multi, readback: str = "planes", span_cap: int = 8
+):
+    if readback == "planes":  # cap unused: keep ONE jit cache entry
+        span_cap = 8
+    return _apply_ops_patched_batch(readback, span_cap)(states, ops, ranks, multi)
 
 
 # ---------------------------------------------------------------------------
@@ -2043,6 +2225,9 @@ def merge_step_sorted_patched(
     group_k: int | None = None,
     has_multi: bool = True,
     t_act: int | None = None,
+    readback: str = "planes",
+    span_cap: int = 8,
+    cand_cap: int = 64,
 ):
     """Sorted merge that also emits per-op patch records.
 
@@ -2073,7 +2258,48 @@ def merge_step_sorted_patched(
     state; PERITEXT_PATCH_PATH=dense forces the dense variant for A/B.
     ``group_k``/``has_multi`` statically specialize the delta scan's
     allowMultiple group resolution from the host census.
+
+    ``readback`` selects the record *transfer* format (orthogonal to the
+    scan-carry ``mode``): "planes" returns the full per-slot mark planes
+    (today's path, the A/B baseline), "compact" reduces them on device to
+    ``[M, span_cap]`` run tables via :func:`compact_mark_records` and
+    drops host-redundant fields (``kind`` — the encoded text rows already
+    carry it), so the D2H readback is proportional to the emitted patches
+    instead of the document.  ``mcount`` carries the true span count; the
+    universe falls back to a planes launch when any row overflows
+    ``span_cap``, so both formats always assemble byte-identical streams.
+    ``cand_cap`` statically sizes the compaction's defined-slot candidate
+    axis from the host's mark-count mirror (defined slots never exceed 2x
+    the mark table — see compact_mark_records).
     """
+
+    def _finish_records(records, cand_def):
+        if readback != "compact":
+            return records
+        if cand_def is None:
+            # Mark-free fast path: no mark rows, hence no spans — the run
+            # tables are statically empty.
+            m_pad = records["written"].shape[0]
+            run_start = jnp.zeros((m_pad, span_cap), jnp.int32)
+            run_end = jnp.zeros((m_pad, span_cap), jnp.int32)
+            count = jnp.zeros((m_pad,), jnp.int32)
+        else:
+            run_start, run_end, count = compact_mark_records(
+                records["written"], records["during"], records["changed"],
+                records["vis"], records["obj_len"], cand_def, span_cap, cand_cap,
+            )
+        out = {
+            "tvalid": records["tvalid"],
+            "index0": records["index0"],
+            "ins_mask": records["ins_mask"],
+            "mstart": run_start,
+            "mend": run_end,
+            "mcount": count,
+        }
+        if "wcache" in records:
+            out["wcache"] = records["wcache"]
+        return out
+
     elem_ctr, elem_act, deleted, chars, orig_idx, length = place_text_batch(
         state.elem_ctr,
         state.elem_act,
@@ -2188,7 +2414,7 @@ def merge_step_sorted_patched(
             # Rows didn't evolve; the persisted cache stays valid once
             # realigned to the new slot coordinates.
             records["wcache"] = _permute_wcache(wcache_in, orig_idx)
-        return new_state, records
+        return new_state, _finish_records(records, None)
 
     # The compact-delta warm path also never materializes the permuted
     # winner cache: the scan reads the cache only through gathers, so the
@@ -2259,7 +2485,7 @@ def merge_step_sorted_patched(
             "obj_len": mrec["obj_len"],
             "wcache": wcache_f,
         }
-        return new_state, records
+        return new_state, _finish_records(records, bnd_def)
 
     ar_c = jnp.arange(c, dtype=jnp.int32)
     empty_wc = jnp.array([-1, -1, 0, 0], jnp.int32)
@@ -2397,7 +2623,7 @@ def merge_step_sorted_patched(
         # patched merge skips the dominance init.
         "wcache": wcache_f,
     }
-    return new_state, records
+    return new_state, _finish_records(records, bnd_def)
 
 
 @functools.lru_cache(maxsize=None)
@@ -2409,10 +2635,14 @@ def _merge_step_sorted_patched_batch(
     group_k: int | None,
     has_multi: bool,
     t_act: int | None,
+    readback: str,
+    span_cap: int,
+    cand_cap: int,
 ):
     kw = dict(
         maxk=maxk, has_marks=has_marks, mode=mode, group_k=group_k,
-        has_multi=has_multi, t_act=t_act,
+        has_multi=has_multi, t_act=t_act, readback=readback, span_cap=span_cap,
+        cand_cap=cand_cap,
     )
     if has_wcache:
         def call(st, t, ro, nr, m, rk, b, mu, tt, mt, wc):
@@ -2449,6 +2679,9 @@ def merge_step_sorted_patched_batch(
     group_k: int | None = None,
     has_multi: bool = True,
     t_act: int | None = None,
+    readback: str = "planes",
+    span_cap: int = 8,
+    cand_cap: int = 64,
 ):
     """Jitted batched entry point for the patch-emitting sorted merge.
 
@@ -2464,13 +2697,23 @@ def merge_step_sorted_patched_batch(
     allowMultiple group census and mark-type registry (dense always
     compiles the full PATCH_GROUP_K / MAX_MARK_TYPES machinery); they are
     normalized here so dense mode keeps ONE jit cache entry.
+    ``readback``/``span_cap`` select the record transfer format (see
+    merge_step_sorted_patched): "compact" reads back [M, span_cap] run
+    tables instead of the [M, 2C] mark planes.
     """
     if mode not in ("delta", "dense"):
         raise ValueError(f"unknown patched merge mode {mode!r}")
+    if readback not in ("planes", "compact"):
+        raise ValueError(f"unknown patch readback format {readback!r}")
     if mode == "dense" or not has_marks:
         group_k, has_multi, t_act = None, True, None
+    if readback == "planes":
+        span_cap = 8  # unused by the planes variant: keep ONE jit cache entry
+    if readback == "planes" or not has_marks:
+        cand_cap = 64  # unused by these variants: keep ONE jit cache entry
     fn = _merge_step_sorted_patched_batch(
-        maxk, has_marks, wcache_in is not None, mode, group_k, has_multi, t_act
+        maxk, has_marks, wcache_in is not None, mode, group_k, has_multi, t_act,
+        readback, span_cap, cand_cap,
     )
     args = [
         states, text_ops, round_of, jnp.int32(num_rounds), mark_ops, ranks,

@@ -102,6 +102,87 @@ def _degrade_enabled() -> bool:
     return os.environ.get("PERITEXT_DEGRADE", "1") != "0"
 
 
+def _patch_readback() -> str:
+    """Record transfer format for the patch-emitting launches.
+
+    "compact" (default): the mark patch planes reduce on device to
+    [M, span_cap] run tables (kernels.compact_mark_records) and only those
+    — plus the analytic text records — cross the D2H link, so readback
+    bytes are proportional to the emitted patches, not the document.
+    "planes" keeps the full [M, 2C] per-slot planes (the A/B baseline).
+    Both assemble byte-identical patch streams.
+    """
+    mode = os.environ.get("PERITEXT_PATCH_READBACK", "compact")
+    if mode not in ("compact", "planes"):
+        raise ValueError(
+            f"PERITEXT_PATCH_READBACK must be 'compact' or 'planes', got {mode!r}"
+        )
+    return mode
+
+
+def _initial_span_cap() -> int:
+    """Starting per-mark-row span capacity for the compact readback
+    (PERITEXT_PATCH_SPAN_CAP, pow2-bucketed).  A mark op's emitted patch
+    count is data-dependent — the host census cannot bound it — so the cap
+    is adaptive instead: a launch whose true counts overflow it falls back
+    to a planes readback for that batch (byte-identical stream either way)
+    and the universe grows its cap so the steady state stops overflowing.
+    """
+    raw = os.environ.get("PERITEXT_PATCH_SPAN_CAP", "8")
+    try:
+        cap = int(raw)
+    except ValueError:
+        raise ValueError(f"PERITEXT_PATCH_SPAN_CAP must be an integer, got {raw!r}")
+    if cap < 1:
+        raise ValueError(f"PERITEXT_PATCH_SPAN_CAP must be >= 1, got {cap}")
+    return bucket_length(cap, minimum=1)
+
+
+def _codepoints_to_str(codepoints: np.ndarray) -> str:
+    """Vectorized codepoint-array -> str (no per-char Python loop).
+
+    surrogatepass: lone surrogates are representable in Python strings
+    (they arrive via JS/JSON escapes and round-trip through ``chr()`` on
+    the per-char assembly paths), so the batch decode must accept exactly
+    what ``chr()`` accepts."""
+    return codepoints.astype("<u4").tobytes().decode("utf-32-le", "surrogatepass")
+
+
+def _decode_mask_row(
+    row: np.ndarray,
+    op_ids: List[str],
+    table: Dict[str, Dict[str, Any]],
+    cache: Dict[bytes, Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Decode one boundary bitset row into an effective mark map via the
+    oracle's ops_to_marks, memoized on the row bytes.  THE one decode
+    shared by every patch assembler (bit unpacking and caching cannot
+    drift between them).  Returns the CACHED dict — callers handing it to
+    patch consumers must ``_copy_jsonlike`` it first."""
+    key = row.tobytes()
+    marks = cache.get(key)
+    if marks is None:
+        present = frozenset(
+            op_id for m, op_id in enumerate(op_ids) if row[m // 32] >> (m % 32) & 1
+        )
+        marks = ops_to_marks(present, table)
+        cache[key] = marks
+    return marks
+
+
+def _copy_jsonlike(x: Any) -> Any:
+    """Cheap structural copy of JSON-shaped patch/mark values (dicts,
+    lists, immutable scalars).  Equal by == to ``copy.deepcopy`` on these
+    shapes at a fraction of the cost — deepcopy's memo/dispatch machinery
+    dominated patch assembly when run once per inserted character and once
+    per host patch per replica."""
+    if isinstance(x, dict):
+        return {k: _copy_jsonlike(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_copy_jsonlike(v) for v in x]
+    return x
+
+
 # Transient-failure classification (shared with the Editor's delivery
 # buffer; see faults.retryable): transient errors retry, semantic errors
 # propagate untouched.
@@ -132,33 +213,40 @@ def assemble_patches(
 
     With ``row_pos`` (the flat batch-stream position of each op row, from
     encode_changes), returns ``(pos, patch)`` pairs instead, so the caller
-    can interleave device patches with host-object patches in op order."""
+    can interleave device patches with host-object patches in op order.
+
+    Consumes either record format: the full per-slot planes, or the
+    compact run tables (``mstart``/``mend``/``mcount`` present) — in which
+    case kind and the insert payload come from the host-side ``op_rows``
+    (the kernel drops host-redundant fields from the compact readback)."""
     patches: List[Any] = []
 
     def emit(i: int, patch: Dict[str, Any]) -> None:
         patches.append(patch if row_pos is None else (int(row_pos[i]), patch))
 
     op_ids = list(table)
+    mask_cache: Dict[bytes, Dict[str, Any]] = {}
 
     def decode_mask(row: np.ndarray) -> Dict[str, Any]:
-        present = frozenset(
-            op_id for m, op_id in enumerate(op_ids) if row[m // 32] >> (m % 32) & 1
-        )
-        return ops_to_marks(present, table)
+        return _copy_jsonlike(_decode_mask_row(row, op_ids, table, mask_cache))
 
-    num_ops = records["kind"].shape[1]
+    compact = "mstart" in records
+    num_ops = op_rows.shape[0] if compact else records["kind"].shape[1]
     for i in range(num_ops):
-        kind = int(records["kind"][r, i])
+        kind = int(op_rows[i, K.K_KIND]) if compact else int(records["kind"][r, i])
         if kind == K.KIND_PAD or not records["valid"][r, i]:
             continue
         if kind == K.KIND_INSERT:
+            char = (
+                int(op_rows[i, K.K_PAYLOAD]) if compact else int(records["char"][r, i])
+            )
             emit(
                 i,
                 {
                     "path": ["text"],
                     "action": "insert",
                     "index": int(records["index"][r, i]),
-                    "values": [chr(int(records["char"][r, i]))],
+                    "values": [chr(char)],
                     "marks": decode_mask(records["ins_mask"][r, i]),
                 },
             )
@@ -173,7 +261,17 @@ def assemble_patches(
                 },
             )
         elif kind == K.KIND_MARK:
-            for patch in assemble_mark_patches(records, r, i, op_rows[i], attrs):
+            if compact:
+                span_patches = _mark_span_patches(
+                    records["mstart"][r, i],
+                    records["mend"][r, i],
+                    int(records["mcount"][r, i]),
+                    op_rows[i],
+                    attrs,
+                )
+            else:
+                span_patches = assemble_mark_patches(records, r, i, op_rows[i], attrs)
+            for patch in span_patches:
                 emit(i, patch)
     return patches
 
@@ -219,6 +317,44 @@ def _mark_patch_list(
     return patches
 
 
+def _mark_span_patches(
+    starts: np.ndarray,
+    ends: np.ndarray,
+    count: int,
+    op_row: np.ndarray,
+    attrs: AttrRegistry,
+) -> List[Dict[str, Any]]:
+    """Reference-format mark patches from one compact run-table row.
+
+    The device compaction (kernels.compact_mark_records) already applied
+    _mark_patch_list's walk — emission order, the next-written span ends,
+    and the finishPartialPatch filters (a filtered lane reads
+    ``end <= start`` and is skipped) — so host assembly is pure dict
+    construction over the row's lanes."""
+    if count <= 0:
+        return []
+    action = "addMark" if int(op_row[K.K_MACTION]) == 0 else "removeMark"
+    mark_type = schema.ALL_MARKS[int(op_row[K.K_MTYPE])]
+    attr_values = attrs.decode(int(op_row[K.K_MATTR]))
+    patches: List[Dict[str, Any]] = []
+    for j in range(min(count, starts.shape[0])):
+        start = int(starts[j])
+        end = int(ends[j])
+        if end <= start:
+            continue  # filtered lane (finishPartialPatch, applied on device)
+        patch: Dict[str, Any] = {
+            "action": action,
+            "markType": mark_type,
+            "path": ["text"],
+            "startIndex": start,
+            "endIndex": end,
+        }
+        if action == "addMark" and mark_type in ("link", "comment"):
+            patch["attrs"] = attr_values
+        patches.append(patch)
+    return patches
+
+
 def assemble_mark_patches(
     records: Dict[str, np.ndarray],
     r: int,
@@ -261,17 +397,11 @@ def assemble_patches_sorted(
     mask_cache: Dict[bytes, Dict[str, Any]] = {}
 
     def decode_mask(row: np.ndarray) -> Dict[str, Any]:
-        key = row.tobytes()
-        marks = mask_cache.get(key)
-        if marks is None:
-            present = frozenset(
-                op_id
-                for m, op_id in enumerate(op_ids)
-                if row[m // 32] >> (m % 32) & 1
-            )
-            marks = ops_to_marks(present, table)
-            mask_cache[key] = marks
-        return copy.deepcopy(marks)
+        # Cheap frozen-structure copy (not deepcopy): each emitted patch
+        # needs its own mutation-safe marks dict, but the values are plain
+        # JSON shapes — deepcopy here ran once per inserted CHARACTER of a
+        # fused run and dominated the single-ingest assembly breakdown.
+        return _copy_jsonlike(_decode_mask_row(row, op_ids, table, mask_cache))
 
     kind = records["kind"][r]
     tvalid = records["tvalid"][r]
@@ -318,6 +448,94 @@ def assemble_patches_sorted(
             records["changed"][r, m],
             records["vis"][r, m],
             int(records["obj_len"][r, m]),
+            mark_rows[m],
+            attrs,
+        ):
+            patches.append((pos, patch))
+    return patches
+
+
+def assemble_patches_sorted_compact(
+    records: Dict[str, np.ndarray],
+    r: int,
+    text_rows: np.ndarray,
+    text_pos: np.ndarray,
+    char_buf: np.ndarray,
+    mark_rows: np.ndarray,
+    mark_pos: np.ndarray,
+    table: Dict[str, Dict[str, Any]],
+    attrs: AttrRegistry,
+) -> List[Any]:
+    """assemble_patches_sorted over the compact run-table records,
+    vectorized: run expansion, index/position arithmetic and char decoding
+    run as numpy batch operations over all text rows at once, and mark
+    patches come straight from the device-compacted spans — the per-patch
+    Python work is dict construction only.  Emits the same (pos, patch)
+    set as the planes assembler for the same launch; every stream position
+    is unique per op (fusion is delivery-adjacency-gated), so the caller's
+    stable sort-by-pos makes the merged streams byte-identical.
+    """
+    patches: List[Any] = []
+    op_ids = list(table)
+    mask_cache: Dict[bytes, Dict[str, Any]] = {}
+    kind = np.asarray(text_rows[:, K.K_KIND])
+    tvalid = np.asarray(records["tvalid"][r]).astype(bool)
+    index0 = np.asarray(records["index0"][r])
+    live = (kind != K.KIND_PAD) & tvalid
+
+    for l in np.flatnonzero(live & (kind == K.KIND_DELETE)).tolist():
+        patches.append(
+            (
+                int(text_pos[l]),
+                {"path": ["text"], "action": "delete", "index": int(index0[l]), "count": 1},
+            )
+        )
+
+    ins = np.flatnonzero(
+        live & ((kind == K.KIND_INSERT) | (kind == K.KIND_INSERT_RUN))
+    )
+    if ins.size:
+        is_run = kind[ins] == K.KIND_INSERT_RUN
+        lens = np.where(is_run, text_rows[ins, K.K_RUN_LEN], 1).astype(np.int64)
+        payload = text_rows[ins, K.K_PAYLOAD].astype(np.int64)
+        total = int(lens.sum())
+        row_of = np.repeat(np.arange(ins.size), lens)
+        off = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)
+        buf_idx = np.minimum(payload[row_of] + off, char_buf.shape[0] - 1)
+        codes = np.where(
+            is_run[row_of], np.asarray(char_buf)[buf_idx], payload[row_of]
+        )
+        text = _codepoints_to_str(codes)
+        pos_flat = (text_pos[ins][row_of] + off).tolist()
+        idx_flat = (index0[ins][row_of] + off).tolist()
+        row_marks = [
+            _decode_mask_row(records["ins_mask"][r, l], op_ids, table, mask_cache)
+            for l in ins.tolist()
+        ]
+        for j in range(total):
+            patches.append(
+                (
+                    pos_flat[j],
+                    {
+                        "path": ["text"],
+                        "action": "insert",
+                        "index": idx_flat[j],
+                        "values": [text[j]],
+                        "marks": _copy_jsonlike(row_marks[row_of[j]]),
+                    },
+                )
+            )
+
+    mcount = np.asarray(records["mcount"][r])
+    mk = np.flatnonzero(
+        (np.asarray(mark_rows[:, K.K_KIND]) == K.KIND_MARK) & (mcount > 0)
+    )
+    for m in mk.tolist():
+        pos = int(mark_pos[m])
+        for patch in _mark_span_patches(
+            records["mstart"][r, m],
+            records["mend"][r, m],
+            int(mcount[m]),
             mark_rows[m],
             attrs,
         ):
@@ -374,6 +592,14 @@ def fold_multi_group_rows(census: Dict[Tuple[int, int], set], rows) -> None:
 
 
 class TpuUniverse:
+    # Process-wide adaptive floor for the compact-readback span capacity:
+    # an overflow in ANY universe raises it, so fresh universes (bench
+    # legs rebuild one per run; fleets churn) start wide enough instead of
+    # each re-paying the planes fallback once per lifetime.  Pow2, so the
+    # jit cache stays bounded; an explicit PERITEXT_PATCH_SPAN_CAP pin
+    # ignores the floor (tests own their cap).
+    _span_cap_floor = 1
+
     def __init__(
         self,
         replica_ids: Sequence[str],
@@ -430,6 +656,16 @@ class TpuUniverse:
         # way.)
         self._wcaches = None
         self._wcaches_actors = 0
+        # Per-mark-row span capacity of the compact patch readback
+        # (kernels.compact_mark_records).  Adaptive: a batch whose true
+        # span counts overflow it re-reads via the planes format (byte-
+        # identical stream) and the cap grows to the observed maximum, so
+        # a workload that keeps emitting wide mark patches stops paying
+        # the fallback after its first overflow.
+        if "PERITEXT_PATCH_SPAN_CAP" in os.environ:
+            self._span_cap = _initial_span_cap()
+        else:
+            self._span_cap = max(_initial_span_cap(), TpuUniverse._span_cap_floor)
         # Lightweight observability counters (the reference's observability
         # is console logging + the demo op panel, SURVEY §5; at batch scale
         # these are what perf debugging needs).
@@ -1266,6 +1502,51 @@ class TpuUniverse:
         chunk = chunk or n
         return math.ceil(n / math.ceil(n / chunk))
 
+    @staticmethod
+    def _cand_cap(prep: Dict[str, Any]) -> int:
+        """Static candidate-axis width for the compact readback: defined
+        boundary slots never exceed 2x the mark table (anchor writes are
+        the only first definitions), and the host mirrors every replica's
+        post-batch mark count — a sound, pow2-bucketed bound."""
+        return bucket_length(
+            2 * int(np.asarray(prep["new_mark_counts"]).max(initial=0)) + 2,
+            minimum=8,
+        )
+
+    def _span_overflow(
+        self, record_chunks: List[Dict[str, np.ndarray]], span_cap: int
+    ) -> bool:
+        """Did any mark row's true span count exceed the compact readback
+        capacity?  If so, tally it and grow the universe's cap (pow2) to
+        the observed maximum so subsequent batches launch wide enough."""
+        overflow = max(
+            (int(rec["mcount"].max(initial=0)) for rec in record_chunks),
+            default=0,
+        )
+        if overflow <= span_cap:
+            return False
+        self.stats["readback_overflows"] = (
+            self.stats.get("readback_overflows", 0) + 1
+        )
+        self._span_cap = bucket_length(overflow, minimum=1)
+        if "PERITEXT_PATCH_SPAN_CAP" not in os.environ:
+            # An env-pinned cap owns its universes (tests, A/B legs):
+            # their deliberate overflows must not inflate the floor every
+            # later un-pinned universe starts from.
+            TpuUniverse._span_cap_floor = max(
+                TpuUniverse._span_cap_floor, self._span_cap
+            )
+        if telemetry.enabled:
+            telemetry.counter("ingest.readback_overflow")
+        _log.info(
+            "compact patch readback overflowed (%d spans > cap %d); "
+            "re-reading via planes and growing the cap to %d",
+            overflow,
+            span_cap,
+            self._span_cap,
+        )
+        return True
+
     def apply_changes_with_patches(
         self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]
     ) -> Dict[str, List[Dict[str, Any]]]:
@@ -1281,6 +1562,13 @@ class TpuUniverse:
         / PERITEXT_PATCH_PATH=scan.  Every path emits the same
         byte-identical reference stream (micromerge dual-path invariant,
         test/micromerge.ts:84-85).
+
+        PERITEXT_PATCH_READBACK selects the record transfer format on
+        every path: "compact" (default) reads back device-compacted span
+        run tables (output-proportional D2H), "planes" the full per-slot
+        planes (the A/B baseline).  Both formats assemble byte-identical
+        streams; a compact launch whose span counts overflow the adaptive
+        cap re-reads that batch via planes.
         """
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
@@ -1295,11 +1583,14 @@ class TpuUniverse:
         # position in the batch stream; device patches get the same tags so
         # the merged stream is in true op order (what an incremental oracle
         # consuming this delivery order would emit).
-        # Host patch lists are shared across a version class; hand each
-        # replica its own deep copy so callers can't alias mutations.
+        # Host patch lists are shared across a version class (one immutable
+        # decode per class, from the _prepare dry-run); each replica
+        # materializes its own mutation-safe copy lazily via the cheap
+        # frozen-structure copy — deepcopy here ran once per patch per
+        # REPLICA per call and scaled with the fleet.
         def host_patches_for(r: int) -> List[Any]:
             return [
-                (pos, copy.deepcopy(p)) for pos, p in prep["host_patches"].get(r, [])
+                (pos, _copy_jsonlike(p)) for pos, p in prep["host_patches"].get(r, [])
             ]
 
         if max_rows == 0:
@@ -1386,30 +1677,53 @@ class TpuUniverse:
         # readback happens inside the attempt, so a mid-loop failure simply
         # discards the partial results (device state is immutable — the
         # committed pytree is untouched until the whole attempt succeeds).
-        def attempt():
-            state_slices = []
-            record_chunks: List[Dict[str, np.ndarray]] = []
-            for i in range(0, n, chunk):
-                sl = slice(i, min(i + chunk, n))
-                faults.fire("device_launch")
-                st, records = K.apply_ops_patched_batch(
-                    jax.tree.map(lambda x: x[sl], self.states),
-                    jax.numpy.asarray(ops[sl]),
-                    ranks,
-                    multi,
+        readback = _patch_readback()
+        span_cap = self._span_cap
+
+        def make_attempt(rb: str):
+            def attempt():
+                state_slices = []
+                record_chunks: List[Dict[str, np.ndarray]] = []
+                for i in range(0, n, chunk):
+                    sl = slice(i, min(i + chunk, n))
+                    faults.fire("device_launch")
+                    st, records = K.apply_ops_patched_batch(
+                        jax.tree.map(lambda x: x[sl], self.states),
+                        jax.numpy.asarray(ops[sl]),
+                        ranks,
+                        multi,
+                        readback=rb,
+                        span_cap=span_cap,
+                    )
+                    state_slices.append(st)
+                    faults.fire("device_readback")
+                    record_chunks.append(
+                        {k: np.asarray(v) for k, v in records.items()}
+                    )
+                states = (
+                    state_slices[0]
+                    if len(state_slices) == 1
+                    else jax.tree.map(
+                        lambda *xs: jax.numpy.concatenate(xs), *state_slices
+                    )
                 )
-                state_slices.append(st)
-                faults.fire("device_readback")
-                record_chunks.append({k: np.asarray(v) for k, v in records.items()})
-            states = (
-                state_slices[0]
-                if len(state_slices) == 1
-                else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
-            )
-            return (states, record_chunks), states.length
+                return (states, record_chunks), states.length
+
+            return attempt
 
         try:
-            new_states, record_chunks = self._run_launch(attempt)
+            new_states, record_chunks = self._run_launch(make_attempt(readback))
+            launches = len(record_chunks)  # successful chunk launches
+            d2h = sum(v.nbytes for rec in record_chunks for v in rec.values())
+            if readback == "compact" and self._span_overflow(record_chunks, span_cap):
+                # Some mark row emitted more spans than the compact tables
+                # hold; re-read this batch via the planes format (device
+                # state is immutable — a relaunch recomputes byte-identical
+                # records) and grow the cap for the next batch.
+                readback = "planes"
+                new_states, record_chunks = self._run_launch(make_attempt("planes"))
+                launches += len(record_chunks)
+                d2h += sum(v.nbytes for rec in record_chunks for v in rec.values())
         except DeviceLaunchError:
             if not _degrade_enabled():
                 raise
@@ -1419,15 +1733,13 @@ class TpuUniverse:
                 for r, name in enumerate(self.replica_ids)
             }
         self.states = new_states
-        self.stats["launches"] += len(record_chunks)  # successful chunk launches
+        self.stats["launches"] += launches
         if telemetry.enabled:
-            telemetry.counter("ingest.launches", len(record_chunks))
+            telemetry.counter("ingest.launches", launches)
             telemetry.counter("ingest.path.scan")
+            telemetry.counter("ingest.readback." + readback)
             telemetry.counter("ingest.h2d_bytes", int(ops.nbytes))
-            telemetry.counter(
-                "ingest.d2h_bytes",
-                int(sum(v.nbytes for rec in record_chunks for v in rec.values())),
-            )
+            telemetry.counter("ingest.d2h_bytes", int(d2h))
         # The interleaved path doesn't maintain the winner cache.
         self._wcaches = None
         self._commit(prep)
@@ -1457,7 +1769,12 @@ class TpuUniverse:
         scan + analytic text records (kernels.merge_step_sorted_patched).
         Record planes are [R, marks, 2C] — only mark rows, not every op —
         so the memory valve matters less, but PERITEXT_PATCH_CHUNK still
-        applies.
+        applies.  Under the default compact readback
+        (PERITEXT_PATCH_READBACK) the planes never cross D2H at all: the
+        launch compacts them to [R, marks, span_cap] run tables and host
+        assembly consumes the spans vectorized
+        (assemble_patches_sorted_compact); overflow of the adaptive cap
+        falls back to a planes re-read for the batch.
 
         The mark-row scan runs as the compact-delta variant by default;
         PERITEXT_PATCH_PATH=dense forces the full-plane-carry variant for
@@ -1528,57 +1845,82 @@ class TpuUniverse:
         ):
             wc = None
 
-        def attempt():
-            state_slices = []
-            record_chunks: List[Dict[str, np.ndarray]] = []
-            wcache_slices = []
-            for i in range(0, n, chunk):
-                sl = slice(i, min(i + chunk, n))
-                faults.fire("device_launch")
-                st, records = K.merge_step_sorted_patched_batch(
-                    jax.tree.map(lambda x: x[sl], self.states),
-                    jax.numpy.asarray(text_ops[sl]),
-                    jax.numpy.asarray(rounds[sl]),
-                    sorted_prep["num_rounds"],
-                    jax.numpy.asarray(mark_ops[sl]),
-                    ranks,
-                    jax.numpy.asarray(bufs[sl]),
-                    multi,
-                    jax.numpy.asarray(text_pos[sl]),
-                    jax.numpy.asarray(mark_pos[sl]),
-                    sorted_prep["maxk"],
-                    has_marks=has_marks,
-                    wcache_in=None if wc is None else wc[sl],
-                    mode=mode,
-                    group_k=group_k,
-                    has_multi=has_multi,
-                    t_act=t_act,
+        readback = _patch_readback()
+        span_cap = self._span_cap
+        cand_cap = self._cand_cap(prep)
+
+        def make_attempt(rb: str):
+            def attempt():
+                state_slices = []
+                record_chunks: List[Dict[str, np.ndarray]] = []
+                wcache_slices = []
+                for i in range(0, n, chunk):
+                    sl = slice(i, min(i + chunk, n))
+                    faults.fire("device_launch")
+                    st, records = K.merge_step_sorted_patched_batch(
+                        jax.tree.map(lambda x: x[sl], self.states),
+                        jax.numpy.asarray(text_ops[sl]),
+                        jax.numpy.asarray(rounds[sl]),
+                        sorted_prep["num_rounds"],
+                        jax.numpy.asarray(mark_ops[sl]),
+                        ranks,
+                        jax.numpy.asarray(bufs[sl]),
+                        multi,
+                        jax.numpy.asarray(text_pos[sl]),
+                        jax.numpy.asarray(mark_pos[sl]),
+                        sorted_prep["maxk"],
+                        has_marks=has_marks,
+                        wcache_in=None if wc is None else wc[sl],
+                        mode=mode,
+                        group_k=group_k,
+                        has_multi=has_multi,
+                        t_act=t_act,
+                        readback=rb,
+                        span_cap=span_cap,
+                        cand_cap=cand_cap,
+                    )
+                    state_slices.append(st)
+                    # Keep the cache on device — reading it back would cost
+                    # more than the init it saves.
+                    wcache_slices.append(records.pop("wcache", None))
+                    faults.fire("device_readback")
+                    record_chunks.append({k: np.asarray(v) for k, v in records.items()})
+                states = (
+                    state_slices[0]
+                    if len(state_slices) == 1
+                    else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
                 )
-                state_slices.append(st)
-                # Keep the cache on device — reading it back would cost
-                # more than the init it saves.
-                wcache_slices.append(records.pop("wcache", None))
-                faults.fire("device_readback")
-                record_chunks.append({k: np.asarray(v) for k, v in records.items()})
-            states = (
-                state_slices[0]
-                if len(state_slices) == 1
-                else jax.tree.map(lambda *xs: jax.numpy.concatenate(xs), *state_slices)
-            )
-            if all(w is not None for w in wcache_slices):
-                wcache = (
-                    wcache_slices[0]
-                    if len(wcache_slices) == 1
-                    else jax.numpy.concatenate(wcache_slices)
-                )
-            else:
-                # Cacheless mark-free launch: rows unchanged but slots
-                # re-permuted, so a stale cache must not survive.
-                wcache = None
-            return (states, record_chunks, wcache), states.length
+                if all(w is not None for w in wcache_slices):
+                    wcache = (
+                        wcache_slices[0]
+                        if len(wcache_slices) == 1
+                        else jax.numpy.concatenate(wcache_slices)
+                    )
+                else:
+                    # Cacheless mark-free launch: rows unchanged but slots
+                    # re-permuted, so a stale cache must not survive.
+                    wcache = None
+                return (states, record_chunks, wcache), states.length
+
+            return attempt
 
         try:
-            new_states, record_chunks, wcache = self._run_launch(attempt)
+            new_states, record_chunks, wcache = self._run_launch(
+                make_attempt(readback)
+            )
+            launches = len(record_chunks)  # successful chunk launches
+            d2h = sum(v.nbytes for rec in record_chunks for v in rec.values())
+            if readback == "compact" and self._span_overflow(record_chunks, span_cap):
+                # Overflowed span tables cannot reconstruct the stream;
+                # re-read this batch via the planes format (byte-identical
+                # records recomputed from the immutable committed state)
+                # and let the grown cap cover the next batch.
+                readback = "planes"
+                new_states, record_chunks, wcache = self._run_launch(
+                    make_attempt("planes")
+                )
+                launches += len(record_chunks)
+                d2h += sum(v.nbytes for rec in record_chunks for v in rec.values())
         except DeviceLaunchError:
             if not _degrade_enabled():
                 raise  # committed state untouched: attempts never assign
@@ -1588,10 +1930,11 @@ class TpuUniverse:
                 for r, name in enumerate(self.replica_ids)
             }
         self.states = new_states
-        self.stats["launches"] += len(record_chunks)  # successful chunk launches
+        self.stats["launches"] += launches
         if telemetry.enabled:
-            telemetry.counter("ingest.launches", len(record_chunks))
+            telemetry.counter("ingest.launches", launches)
             telemetry.counter("ingest.path." + mode)
+            telemetry.counter("ingest.readback." + readback)
             telemetry.counter(
                 "ingest.h2d_bytes",
                 int(
@@ -1603,10 +1946,7 @@ class TpuUniverse:
                     + mark_pos.nbytes
                 ),
             )
-            telemetry.counter(
-                "ingest.d2h_bytes",
-                int(sum(v.nbytes for rec in record_chunks for v in rec.values())),
-            )
+            telemetry.counter("ingest.d2h_bytes", int(d2h))
         self._wcaches = wcache
         if wcache is not None:
             # ranks() used by this launch reflect the post-_prepare
@@ -1615,10 +1955,15 @@ class TpuUniverse:
         self._commit(prep)
         tables = self._batch_mark_op_table()
         out: Dict[str, List[Dict[str, Any]]] = {}
+        assemble = (
+            assemble_patches_sorted_compact
+            if readback == "compact"
+            else assemble_patches_sorted
+        )
         for r, name in enumerate(self.replica_ids):
             rec = record_chunks[r // chunk]
             gi = int(group_of[r])
-            dev = assemble_patches_sorted(
+            dev = assemble(
                 rec,
                 r % chunk,
                 sorted_prep["text"][gi],
@@ -1696,8 +2041,9 @@ class TpuUniverse:
 
     @staticmethod
     def _codepoints_to_str(codepoints: np.ndarray) -> str:
-        """Vectorized codepoint-array -> str (no per-char Python loop)."""
-        return codepoints.astype("<u4").tobytes().decode("utf-32-le")
+        """Vectorized codepoint-array -> str (module helper; surrogatepass
+        so the batch decode accepts exactly what chr() accepts)."""
+        return _codepoints_to_str(codepoints)
 
     def _spans_from_arrays(
         self,

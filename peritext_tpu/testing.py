@@ -20,6 +20,28 @@ SCAN_FORCING_KNOBS = ("PERITEXT_PATCH_PATH", "PERITEXT_MERGE_PATH")
 
 
 @contextmanager
+def patch_readback_env(mode: Optional[str] = None):
+    """Pin the patch-record readback format (PERITEXT_PATCH_READBACK) for
+    a measurement or differential leg.
+
+    ``mode=None`` clears the knob (the compact default becomes active
+    regardless of ambient CI env); ``"planes"`` / ``"compact"`` pin that
+    format.  The caller's environment is restored on exit.
+    """
+    saved = os.environ.get("PERITEXT_PATCH_READBACK")
+    os.environ.pop("PERITEXT_PATCH_READBACK", None)
+    if mode:
+        os.environ["PERITEXT_PATCH_READBACK"] = mode
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop("PERITEXT_PATCH_READBACK", None)
+        else:
+            os.environ["PERITEXT_PATCH_READBACK"] = saved
+
+
+@contextmanager
 def patch_path_env(mode: Optional[str] = None):
     """Pin the patch-path selection for a measurement or differential leg.
 

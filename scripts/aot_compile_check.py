@@ -55,6 +55,7 @@ def shard_map(*a, **kw):
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from peritext_tpu.bench.workloads import build_device_batch, make_merge_workload  # noqa: E402
+from peritext_tpu.ops import kernels as XK  # noqa: E402
 from peritext_tpu.ops import pallas_kernels as PK  # noqa: E402
 
 TOPOLOGY = os.environ.get("AOT_TOPOLOGY", "v5e:2x2x1")
@@ -152,14 +153,42 @@ def main() -> int:
             sds(lat_cbuf, row)
         ).compile()
 
+    def check_compact():
+        # ISSUE 8: the device-side patch-span compaction
+        # (kernels.compact_mark_records — plain XLA, not Pallas, but its
+        # TPU lowering of top_k / cummin / take_along_axis deserves the
+        # same relay-free compile proof).  Batched over the replica axis
+        # at the bench-ish record shape.
+        R, M, two_c, cap = 8 * n_dev, 16, 512, 8
+        f = jax.jit(
+            jax.vmap(
+                functools.partial(
+                    XK.compact_mark_records, span_cap=cap, cand_cap=64
+                )
+            )
+        )
+        bsd = jax.ShapeDtypeStruct((R, M, two_c), jnp.bool_, sharding=row)
+        f.lower(
+            bsd,
+            bsd,
+            bsd,
+            jax.ShapeDtypeStruct((R, M, two_c), jnp.int32, sharding=row),
+            jax.ShapeDtypeStruct((R, M), jnp.int32, sharding=row),
+            jax.ShapeDtypeStruct((R, two_c), jnp.bool_, sharding=row),
+        ).compile()
+
     checks = {
         "text": check_text,
         "mark": check_mark,
         "full": check_full,
         "latency": check_latency,
+        "compact": check_compact,
     }
     if which != "all" and which not in checks:
-        print(f"usage: {sys.argv[0]} [text|mark|full|latency|all] (got {which!r})")
+        print(
+            f"usage: {sys.argv[0]} [text|mark|full|latency|compact|all]"
+            f" (got {which!r})"
+        )
         return 2
     names = list(checks) if which == "all" else [which]
     for name in names:

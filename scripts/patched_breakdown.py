@@ -7,10 +7,14 @@ commit + mark-table build, and the per-replica host patch assembly — so the
 no-patch vs patched gap can be attributed before optimizing.
 
     python scripts/patched_breakdown.py [R] [ops_per_merge] [--path MODE]
+                                        [--readback FORMAT]
 
 ``--path delta|dense|both`` selects the mark-row scan variant (default
 ``both``: one breakdown per variant over the identical stream — the
-compact-delta vs full-plane A/B in one invocation).
+compact-delta vs full-plane A/B in one invocation).  ``--readback
+compact|planes`` pins the record transfer format (default: the ambient
+env / compact); the host-assembly phase wraps BOTH assemblers, so the
+attribution stays honest either way.
 """
 import os
 import sys
@@ -40,6 +44,14 @@ def main() -> int:
         del argv[i : i + 2]
     if path not in ("delta", "dense", "both"):
         raise SystemExit(f"--path must be delta|dense|both, got {path!r}")
+    readback = None
+    if "--readback" in argv:
+        i = argv.index("--readback")
+        readback = argv[i + 1]
+        del argv[i : i + 2]
+        if readback not in ("compact", "planes"):
+            raise SystemExit(f"--readback must be compact|planes, got {readback!r}")
+        os.environ["PERITEXT_PATCH_READBACK"] = readback
     args = [a for a in argv if not a.startswith("--")]
     R = int(args[0]) if len(args) > 0 else 64
     ops_per_merge = int(args[1]) if len(args) > 1 else 64
@@ -118,6 +130,7 @@ def main() -> int:
     wrap(TpuUniverse, "_commit", "commit")
     wrap(TpuUniverse, "_batch_mark_op_table", "mark_table")
     wrap(U, "assemble_patches_sorted", "assemble_host")
+    wrap(U, "assemble_patches_sorted_compact", "assemble_host")
 
     from peritext_tpu.testing import patch_path_env
 

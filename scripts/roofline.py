@@ -329,6 +329,36 @@ def main() -> int:
             {"group_k": 4, "t_act": 4},
         )
 
+    if want("patched_compact"):
+        # The compact-readback variant of the steady-state launch
+        # (ISSUE 8): same compact-delta threaded-cache program, but the
+        # record outputs are [M, span_cap] run tables instead of the
+        # [M, 2C] mark planes — the D2H seam the readback cut targets.
+        from peritext_tpu.schema import allow_multiple_array as _ama
+
+        multi = sds(_ama(), repl)
+        tpos = sds(np.zeros(sp["text"].shape[:2], np.int32), row)
+        mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
+        n_types = int(np.asarray(_ama()).shape[0])
+        wc = sds(
+            np.zeros((R, 2 * capacity, n_types, 4), np.int32), row
+        )
+        compact_d = jax.jit(
+            lambda st, t, ro, m, rk, b, mu, tp, mp, w: K.merge_step_sorted_patched_batch(
+                st, t, ro, sp["num_rounds"], m, rk, b, mu, tp, mp, sp["maxk"],
+                wcache_in=w, mode="delta", group_k=4, t_act=4,
+                readback="compact",
+            )
+        ).lower(
+            st_sds, text, rounds_sds, marks, ranks, bufs, multi, tpos, mpos, wc
+        ).compile()
+        report(
+            "merge_step_sorted_patched @bench (compact readback, threaded cache)",
+            compact_d,
+            per_chip_ops,
+            {"group_k": 4, "t_act": 4, "readback": "compact"},
+        )
+
     if not want("latency"):
         return 0
 

@@ -1,0 +1,336 @@
+"""Compact-vs-planes patch-record readback differentials (ISSUE 8).
+
+The compact readback (device-side span compaction, kernels.
+compact_mark_records + the vectorized host assembler) must be
+indistinguishable from the planes readback — byte-identical assembled
+Patch streams AND byte-identical committed device planes — on every
+patched path (delta / dense / the interleaved scan), across randomized
+batches, zero-width marks, fused insert runs, over-cap allowMultiple
+groups, and under fault-injected degradation.  The adaptive span cap's
+overflow fallback must also be stream-invisible.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from peritext_tpu.fuzz import (
+    _random_add_mark,
+    _random_delete,
+    _random_insert,
+    _random_remove_mark,
+)
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.oracle import Doc
+from peritext_tpu.testing import generate_docs, patch_path_env, patch_readback_env
+
+PATHS = ("delta", "dense", "scan")
+READBACKS = ("compact", "planes")
+
+STATE_FIELDS = (
+    "elem_ctr", "elem_act", "deleted", "chars", "bnd_def", "bnd_mask",
+    "mark_ctr", "mark_act", "mark_action", "mark_type", "mark_attr",
+    "length", "mark_count",
+)
+
+
+def _env_mode(mode):
+    return None if mode == "delta" else mode
+
+
+def _run(stream, path, readback, replicas=("observer",), batches=None, **uni_kw):
+    batches = batches or {replicas[0]: stream}
+    with patch_path_env(_env_mode(path)), patch_readback_env(readback):
+        uni = TpuUniverse(list(replicas), **uni_kw)
+        out = uni.apply_changes_with_patches(batches)
+    planes = {f: np.asarray(getattr(uni.states, f)).copy() for f in STATE_FIELDS}
+    spans = [uni.spans(r) for r in replicas]
+    return out, planes, spans, uni
+
+
+def _assert_readbacks_equal(stream, replicas=("observer",), batches=None, **uni_kw):
+    """One delivery through every (path, readback) cell; the compact cell
+    must match its planes sibling byte-for-byte on everything a caller
+    can observe."""
+    ref = {}
+    for path in PATHS:
+        out_p, planes_p, spans_p, _ = _run(
+            stream, path, "planes", replicas=replicas, batches=batches, **uni_kw
+        )
+        out_c, planes_c, spans_c, uni_c = _run(
+            stream, path, "compact", replicas=replicas, batches=batches, **uni_kw
+        )
+        assert out_c == out_p, f"patch stream differs: compact vs planes [{path}]"
+        for f in STATE_FIELDS:
+            assert (planes_c[f] == planes_p[f]).all(), (
+                f"device plane {f} differs: compact vs planes [{path}]"
+            )
+        assert spans_c == spans_p, f"spans differ: compact vs planes [{path}]"
+        ref[path] = (out_c, uni_c)
+    return ref
+
+
+def _oracle_stream(stream):
+    oracle = Doc("oracle-observer")
+    patches = []
+    for change in stream:
+        patches.extend(oracle.apply_change(change))
+    return oracle, patches
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_compact_matches_planes_random(seed):
+    """Randomized multi-writer streams (inserts, deletes, marks, comments)
+    through the full (path, readback) matrix, two replicas with
+    different-size batches, checked against the oracle."""
+    rng = random.Random(seed + 777)
+    docs, _, initial_change = generate_docs("Compact readback!", 3)
+    stream = [initial_change]
+    comment_history = []
+    for _ in range(12):
+        doc = docs[rng.randrange(3)]
+        for _ in range(rng.randrange(1, 4)):
+            kind = rng.choice(["insert", "insert", "remove", "addMark", "removeMark"])
+            if kind == "insert":
+                op = _random_insert(rng, doc, 4)
+            elif kind == "remove":
+                op = _random_delete(rng, doc)
+            elif kind == "addMark":
+                op = _random_add_mark(rng, doc, comment_history)
+            else:
+                op = _random_remove_mark(rng, doc, comment_history, False)
+            if op is not None:
+                change, _ = doc.change([op])
+                stream.append(change)
+                for other in docs:
+                    if other is not doc:
+                        other.apply_change(change)
+
+    oracle, oracle_patches = _oracle_stream(stream)
+    batches = {"observer": stream, "late": stream[: len(stream) // 2]}
+    ref = _assert_readbacks_equal(
+        stream, replicas=("observer", "late"), batches=batches
+    )
+    assert ref["delta"][0]["observer"] == oracle_patches
+    assert oracle.get_text_with_formatting(["text"])  # sanity: non-empty doc
+
+
+def test_compact_on_fused_insert_runs():
+    """Long single-writer typing runs fuse into KIND_INSERT_RUN rows; the
+    vectorized assembler's run expansion (positions, indices, chars,
+    shared inherited-marks decode) must match the planes walk exactly."""
+    docs, _, initial_change = generate_docs("run:", 2)
+    doc = docs[0]
+    stream = [initial_change]
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 4,
+          "markType": "strong"}]
+    )
+    stream.append(change)
+    # A fused typing burst under the mark (inherits it) and one past the
+    # end (inherits nothing).
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 2,
+          "values": list("abcdefghij")}]
+    )
+    stream.append(change)
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 14, "values": list("xyz")}]
+    )
+    stream.append(change)
+    oracle, oracle_patches = _oracle_stream(stream)
+    ref = _assert_readbacks_equal(stream)
+    assert ref["delta"][0]["observer"] == oracle_patches
+
+
+def test_compact_on_zero_width_marks():
+    """Zero-width marks pin the same-slot -> endOfText walk edge; the
+    device span compaction must reproduce the planes walk's emission
+    (including the finishPartialPatch filters) bit-for-bit."""
+    docs, _, initial_change = generate_docs("ABCDE")
+    doc = docs[0]
+    stream = [initial_change]
+    for op in (
+        {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 2,
+         "markType": "strong"},
+        {"path": ["text"], "action": "addMark", "startIndex": 3, "endIndex": 3,
+         "markType": "link", "attrs": {"url": "x.example"}},
+        {"path": ["text"], "action": "insert", "index": 3, "values": list("xy")},
+        {"path": ["text"], "action": "removeMark", "startIndex": 1, "endIndex": 4,
+         "markType": "strong"},
+    ):
+        change, _ = doc.change([op])
+        stream.append(change)
+    oracle, oracle_patches = _oracle_stream(stream)
+    ref = _assert_readbacks_equal(stream)
+    assert ref["delta"][0]["observer"] == oracle_patches
+
+
+def test_compact_on_over_cap_multi_group():
+    """An allowMultiple group past PATCH_GROUP_K routes to the interleaved
+    scan; the compact readback must ride that fallback byte-identically."""
+    from peritext_tpu.ops import kernels as K
+
+    docs, _, initial_change = generate_docs("overflow compact")
+    doc = docs[0]
+    stream = [initial_change]
+    for i in range(K.PATCH_GROUP_K + 1):
+        action = "addMark" if i % 2 == 0 else "removeMark"
+        change, _ = doc.change(
+            [{"path": ["text"], "action": action, "startIndex": i % 5,
+              "endIndex": 6 + (i % 4), "markType": "comment",
+              "attrs": {"id": "hot"}}]
+        )
+        stream.append(change)
+    oracle, oracle_patches = _oracle_stream(stream)
+    with patch_path_env(None), patch_readback_env("compact"):
+        uni = TpuUniverse(["observer"])
+        out = uni.apply_changes_with_patches({"observer": stream})["observer"]
+    assert uni.stats.get("multi_group_fallbacks", 0) > 0
+    assert out == oracle_patches
+    _assert_readbacks_equal(stream)
+
+
+def test_span_cap_overflow_falls_back_to_planes(monkeypatch):
+    """A mark op emitting more spans than the cap: the batch re-reads via
+    planes (stream-invisible), the overflow is tallied, and the grown cap
+    stops the next batch from overflowing."""
+    monkeypatch.setenv("PERITEXT_PATCH_SPAN_CAP", "1")
+    docs, _, genesis = generate_docs("overflow span cap test", 2)
+    doc = docs[0]
+    # Two disjoint strong regions + one removeMark across both -> >= 2
+    # spans from one op.
+    ops = [
+        {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 5,
+         "markType": "strong"},
+        {"path": ["text"], "action": "addMark", "startIndex": 8, "endIndex": 12,
+         "markType": "strong"},
+        {"path": ["text"], "action": "removeMark", "startIndex": 0, "endIndex": 20,
+         "markType": "strong"},
+    ]
+    stream = [genesis]
+    for op in ops:
+        change, _ = doc.change([op])
+        stream.append(change)
+
+    with patch_path_env(None), patch_readback_env("compact"):
+        uni = TpuUniverse(["x"])
+        out_c = uni.apply_changes_with_patches({"x": stream})
+    assert uni.stats.get("readback_overflows", 0) >= 1
+    assert uni._span_cap > 1  # grew to cover the observed width
+    with patch_path_env(None), patch_readback_env("planes"):
+        ref = TpuUniverse(["x"])
+        out_p = ref.apply_changes_with_patches({"x": stream})
+    assert out_c == out_p
+    for f in STATE_FIELDS:
+        assert (
+            np.asarray(getattr(uni.states, f)) == np.asarray(getattr(ref.states, f))
+        ).all(), f
+
+    # Next batch at the grown cap: no further overflow.
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "addMark", "startIndex": 1, "endIndex": 3,
+          "markType": "em"}]
+    )
+    before = uni.stats.get("readback_overflows", 0)
+    with patch_path_env(None), patch_readback_env("compact"):
+        uni.apply_changes_with_patches({"x": [change]})
+    assert uni.stats.get("readback_overflows", 0) == before
+
+
+def test_compact_degrades_byte_identically_under_faults(monkeypatch):
+    """Faults leg: compact-readback ingest whose launch budget exhausts
+    degrades to the oracle CPU path — stream and planes must match a
+    fault-free control byte-for-byte, exactly as the planes readback
+    does."""
+    from peritext_tpu.runtime import faults
+
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    docs, _, genesis = generate_docs("compact under fire", count=2)
+    a, b = docs
+    c1, _ = a.change(
+        [{"path": ["text"], "action": "insert", "index": 3, "values": list("!!")},
+         {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 8,
+          "markType": "strong"},
+         {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 10,
+          "markType": "comment", "attrs": {"id": "chaos"}}]
+    )
+    b.apply_change(c1)
+
+    with patch_path_env(None), patch_readback_env("compact"):
+        ctrl = TpuUniverse(["doc1", "doc2"])
+        ctrl.apply_changes_with_patches({"doc1": [genesis], "doc2": [genesis]})
+        control = ctrl.apply_changes_with_patches({"doc1": [c1], "doc2": [c1]})
+
+        uni_d = TpuUniverse(["doc1", "doc2"])
+        uni_d.apply_changes_with_patches({"doc1": [genesis], "doc2": [genesis]})
+        faults.install("seed=3;device_launch:fail=99")
+        degraded = uni_d.apply_changes_with_patches({"doc1": [c1], "doc2": [c1]})
+        faults.reset()
+        assert uni_d.stats["degraded_batches"] == 1
+
+    assert degraded == control
+    for f in STATE_FIELDS:
+        ref = np.asarray(getattr(ctrl.states, f))
+        assert (np.asarray(getattr(uni_d.states, f)) == ref).all(), f
+    assert (ctrl.digests() == uni_d.digests()).all()
+
+
+def test_compact_handles_lone_surrogates():
+    """Lone surrogate code points (JS/JSON escapes) must assemble
+    identically through both readbacks — the vectorized assembler's batch
+    utf-32 decode has to accept exactly what chr() accepts."""
+    docs, _, genesis = generate_docs("ab", 1)
+    doc = docs[0]
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 1,
+          "values": ["\ud800", "x", "\udfff"]}]
+    )
+    stream = [genesis, change]
+    outs = []
+    for rb in READBACKS:
+        for path in PATHS:
+            with patch_path_env(_env_mode(path)), patch_readback_env(rb):
+                uni = TpuUniverse(["s"])
+                outs.append(uni.apply_changes_with_patches({"s": stream})["s"])
+                assert uni.texts()[0] == "a\ud800x\udfffb"
+    assert all(o == outs[0] for o in outs)
+
+
+def test_compact_d2h_bytes_cut():
+    """The point of the exercise: at a modest marked-batch shape the
+    compact readback's D2H record bytes must undercut the planes readback
+    by at least 5x (the ISSUE 8 acceptance bar at the bench shape — the
+    gap only widens with capacity)."""
+    from peritext_tpu.runtime import telemetry
+
+    docs, _, genesis = generate_docs("d2h bytes cut " * 8, 2)
+    doc = docs[0]
+    stream = [genesis]
+    for i in range(4):
+        change, _ = doc.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": i,
+              "endIndex": 20 + i, "markType": "strong" if i % 2 else "em"}]
+        )
+        stream.append(change)
+    change, _ = doc.change(
+        [{"path": ["text"], "action": "insert", "index": 5, "values": list("typing")}]
+    )
+    stream.append(change)
+
+    def d2h(readback):
+        telemetry.reset()  # pristine registry (reset also disables)
+        telemetry.enable()
+        try:
+            with patch_path_env(None), patch_readback_env(readback):
+                uni = TpuUniverse(["x", "y"])
+                uni.apply_changes_with_patches({"x": stream, "y": stream})
+            return telemetry.snapshot()["counters"].get("ingest.d2h_bytes", 0)
+        finally:
+            telemetry.reset()
+
+    planes = d2h("planes")
+    compact = d2h("compact")
+    assert compact > 0 and planes > 0
+    assert planes >= 5 * compact, (planes, compact)
