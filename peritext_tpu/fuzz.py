@@ -603,7 +603,16 @@ def _print_telemetry_summary() -> None:
 
     from peritext_tpu.runtime import health, telemetry
 
-    print("telemetry: " + json.dumps(telemetry.summary(), sort_keys=True), flush=True)
+    summary = telemetry.summary()
+    # Causal health rides along with the tallies: the e2e latency
+    # percentiles appear whenever the engine under test fed them (TpuDoc /
+    # queue / pubsub seams), and the flight-recorder counts are always
+    # stated — a soak that silently overwrote its ring is a soak whose
+    # post-mortem window shrank, which the operator should see.
+    rec_n, rec_dropped = telemetry.recorder_stats()
+    summary.setdefault("recorder_events", rec_n)
+    summary.setdefault("recorder_dropped", rec_dropped)
+    print("telemetry: " + json.dumps(summary, sort_keys=True), flush=True)
     health_summary = health.summary()
     if health_summary:
         print("health: " + json.dumps(health_summary, sort_keys=True), flush=True)

@@ -179,6 +179,11 @@ class TpuDoc:
             "text_obj": uni.text_objs[0],
         }
         self._snap = snap
+        # Causal lane for this local change: minted here, stepped by every
+        # seam the generation crosses (device queries, ingest launches,
+        # retries), finished at commit — or at rollback, so the lane's
+        # fate is always recorded.
+        ctx = telemetry.flow("doc.change", actor=self.actor_id) if telemetry.enabled else None
         try:
             deps = dict(self.clock)
             # Seq resumes from our own clock entry after log-replay recovery
@@ -194,10 +199,18 @@ class TpuDoc:
             }
             patches: List[Patch] = []
             with telemetry.span("doc.change", actor=self.actor_id):
-                for input_op in input_ops:
-                    patches.extend(self._generate_input_op(change, input_op))
+                telemetry.flow_point(ctx)
+                with telemetry.flowing((ctx,)):
+                    for input_op in input_ops:
+                        patches.extend(self._generate_input_op(change, input_op))
+                if ctx is not None:
+                    telemetry.observe(
+                        "e2e.change_to_applied", telemetry.flow_elapsed_s(ctx)
+                    )
+                    telemetry.flow_point(ctx, terminal=True)
             if telemetry.enabled:
                 telemetry.counter("doc.local_changes")
+                telemetry.record("doc.change", flow=ctx, outcome="applied")
             return change, patches
         except Exception as exc:
             # Backend-side failure (retry exhaustion, an injected fault, or
@@ -220,6 +233,17 @@ class TpuDoc:
                     getattr(exc, "cause", None), health.BreakerOpenError
                 ):
                     telemetry.counter("doc.local_fastfails")
+                telemetry.record(
+                    "doc.change",
+                    flow=ctx,
+                    outcome="rollback",
+                    error=type(exc).__name__,
+                )
+                if ctx is not None:
+                    # The lane must still finish — inside a span, so the
+                    # flow event binds to a slice (the rollback itself).
+                    with telemetry.span("doc.rollback", actor=self.actor_id):
+                        telemetry.flow_point(ctx, terminal=True, outcome="rollback")
             self.seq = snap["seq"]
             self.max_op = snap["max_op"]
             if snap["clock_entry"] is None:

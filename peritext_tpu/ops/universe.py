@@ -16,6 +16,7 @@ algebra, mark resolution, digests.
 from __future__ import annotations
 
 import copy
+import functools
 import hashlib
 import json
 import logging
@@ -187,6 +188,33 @@ def _copy_jsonlike(x: Any) -> Any:
 # buffer; see faults.retryable): transient errors retry, semantic errors
 # propagate untouched.
 _retryable = faults.retryable
+
+
+def _blackbox_on_error(fn):
+    """Black-box post-mortem on an unhandled ingest exception.
+
+    A no-op unless ``PERITEXT_BLACKBOX`` is armed (telemetry.blackbox_dump
+    checks and returns immediately).  :class:`DeviceLaunchError` is
+    excluded — the retry machinery already dumped at budget exhaustion,
+    and a second dump for the same failure would waste the per-process
+    dump budget.  The exception always propagates unchanged.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        except DeviceLaunchError:
+            raise
+        except Exception as exc:
+            telemetry.blackbox_dump(
+                "ingest_exception",
+                method=fn.__name__,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            raise
+
+    return wrapper
 
 
 def apply_host_op(store: ObjectStore, op: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -831,6 +859,12 @@ class TpuUniverse:
         decision = health.ALLOW if br is None else br.admit()
         if decision == health.FASTFAIL:
             self.stats["fastfails"] = self.stats.get("fastfails", 0) + 1
+            if telemetry.enabled:
+                telemetry.record(
+                    "ingest.launch",
+                    flow=telemetry.current_flow(),
+                    outcome="fastfail",
+                )
             raise DeviceLaunchError(0, health.BreakerOpenError("device_launch"))
         retries, backoff, timeout = _launch_policy()
         if decision == health.CANARY:
@@ -852,6 +886,13 @@ class TpuUniverse:
                     if telemetry.enabled:
                         telemetry.counter("ingest.launch_attempts")
                     with telemetry.span("ingest.launch_attempt", attempt=i):
+                        if telemetry.enabled:
+                            # Join whatever causal lanes the enclosing
+                            # flush/change/delivery scoped onto this
+                            # thread — every retry attempt is its own
+                            # flow step, so Perfetto lanes show the
+                            # retries, not just the final success.
+                            telemetry.flow_steps(attempt=i)
                         result, barrier_leaf = attempt()
                         if needs_barrier or timeout > 0:
                             faults.fire("device_readback")
@@ -871,6 +912,13 @@ class TpuUniverse:
                         raise  # semantic error: no backend-health signal
                     if telemetry.enabled:
                         telemetry.counter("ingest.launch_failures")
+                        telemetry.record(
+                            "ingest.launch",
+                            flow=telemetry.current_flow(),
+                            outcome="fail",
+                            attempt=i,
+                            error=type(exc).__name__,
+                        )
                     if br is not None:
                         br.record_failure()
                     last = exc
@@ -879,7 +927,24 @@ class TpuUniverse:
                     continue
                 if br is not None:
                     br.record_success()
+                if telemetry.enabled:
+                    telemetry.record(
+                        "ingest.launch",
+                        flow=telemetry.current_flow(),
+                        outcome="ok",
+                        attempt=i,
+                    )
                 return result
+            # Launch budget exhausted: the wedged-relay post-mortem moment —
+            # dump the flight recorder + registry before the caller degrades
+            # (or propagates), while the failing batch's trail is still in
+            # the ring.
+            telemetry.blackbox_dump(
+                "launch_budget_exhausted",
+                site="device_launch",
+                attempts=attempts,
+                cause=repr(last),
+            )
             raise DeviceLaunchError(attempts, last) from last
         except BaseException:
             # Any verdict-less exit — a semantic error, or a BaseException
@@ -1101,6 +1166,18 @@ class TpuUniverse:
     # -- oracle degradation (the CPU fallback after retry exhaustion) --------
 
     def _degrade_apply(self, prep: Dict[str, Any]) -> Dict[int, List[Any]]:
+        """Traced wrapper for :meth:`_degrade_apply_impl`: the degradation
+        is a seam every affected causal lane must step through (it IS the
+        batch's completion path), and a flight-recorder event marks it."""
+        with telemetry.span("ingest.degrade", ingested=prep["ingested"]):
+            if telemetry.enabled:
+                telemetry.flow_steps(path="degrade")
+                telemetry.record(
+                    "ingest.degrade", outcome="ok", ingested=prep["ingested"]
+                )
+            return self._degrade_apply_impl(prep)
+
+    def _degrade_apply_impl(self, prep: Dict[str, Any]) -> Dict[int, List[Any]]:
         """Complete a prepared batch through the oracle CPU engine.
 
         The resilience endgame: the device launch kept failing past its
@@ -1346,6 +1423,7 @@ class TpuUniverse:
             raise ValueError("need one change list per replica")
         return batches
 
+    @_blackbox_on_error
     def apply_changes(self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]) -> None:
         """Apply a batch of changes to each named replica in one device launch.
 
@@ -1547,6 +1625,7 @@ class TpuUniverse:
         )
         return True
 
+    @_blackbox_on_error
     def apply_changes_with_patches(
         self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]
     ) -> Dict[str, List[Dict[str, Any]]]:
@@ -1697,9 +1776,15 @@ class TpuUniverse:
                     )
                     state_slices.append(st)
                     faults.fire("device_readback")
-                    record_chunks.append(
-                        {k: np.asarray(v) for k, v in records.items()}
-                    )
+                    # The np.asarray barrier IS the record D2H transfer —
+                    # span it here so the critical-path report attributes
+                    # readback time separately from device dispatch.
+                    with telemetry.span("ingest.readback", readback=rb, chunk=i):
+                        if telemetry.enabled:
+                            telemetry.flow_steps(readback=rb)
+                        record_chunks.append(
+                            {k: np.asarray(v) for k, v in records.items()}
+                        )
                 states = (
                     state_slices[0]
                     if len(state_slices) == 1
@@ -1740,19 +1825,27 @@ class TpuUniverse:
             telemetry.counter("ingest.readback." + readback)
             telemetry.counter("ingest.h2d_bytes", int(ops.nbytes))
             telemetry.counter("ingest.d2h_bytes", int(d2h))
+            # Record-readback accounting (the span covering the actual
+            # D2H barrier lives inside the attempt closure above).
+            telemetry.record(
+                "ingest.readback", fmt=readback, d2h_bytes=int(d2h)
+            )
         # The interleaved path doesn't maintain the winner cache.
         self._wcaches = None
         self._commit(prep)
-        tables = self._batch_mark_op_table()
-        out: Dict[str, List[Dict[str, Any]]] = {}
-        for r, name in enumerate(self.replica_ids):
-            rec = record_chunks[r // chunk]
-            g = groups[group_of[r]]
-            dev = assemble_patches(
-                rec, r % chunk, ops[r], tables[r], self.attrs, row_pos=g["row_pos"]
-            )
-            merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
-            out[name] = [p for _, p in merged]
+        with telemetry.span("ingest.assemble", replicas=len(self.replica_ids)):
+            if telemetry.enabled:
+                telemetry.flow_steps()
+            tables = self._batch_mark_op_table()
+            out: Dict[str, List[Dict[str, Any]]] = {}
+            for r, name in enumerate(self.replica_ids):
+                rec = record_chunks[r // chunk]
+                g = groups[group_of[r]]
+                dev = assemble_patches(
+                    rec, r % chunk, ops[r], tables[r], self.attrs, row_pos=g["row_pos"]
+                )
+                merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
+                out[name] = [p for _, p in merged]
         return out
 
     def _patched_sorted(
@@ -1884,7 +1977,15 @@ class TpuUniverse:
                     # more than the init it saves.
                     wcache_slices.append(records.pop("wcache", None))
                     faults.fire("device_readback")
-                    record_chunks.append({k: np.asarray(v) for k, v in records.items()})
+                    # The np.asarray barrier IS the record D2H transfer —
+                    # span it here so the critical-path report attributes
+                    # readback time separately from device dispatch.
+                    with telemetry.span("ingest.readback", readback=rb, chunk=i):
+                        if telemetry.enabled:
+                            telemetry.flow_steps(readback=rb)
+                        record_chunks.append(
+                            {k: np.asarray(v) for k, v in records.items()}
+                        )
                 states = (
                     state_slices[0]
                     if len(state_slices) == 1
@@ -1947,35 +2048,43 @@ class TpuUniverse:
                 ),
             )
             telemetry.counter("ingest.d2h_bytes", int(d2h))
+            # Record-readback accounting (the span covering the actual
+            # D2H barrier lives inside the attempt closure above).
+            telemetry.record(
+                "ingest.readback", fmt=readback, d2h_bytes=int(d2h)
+            )
         self._wcaches = wcache
         if wcache is not None:
             # ranks() used by this launch reflect the post-_prepare
             # registry; key the cache to it.
             self._wcaches_actors = len(self.actors.actors)
         self._commit(prep)
-        tables = self._batch_mark_op_table()
-        out: Dict[str, List[Dict[str, Any]]] = {}
-        assemble = (
-            assemble_patches_sorted_compact
-            if readback == "compact"
-            else assemble_patches_sorted
-        )
-        for r, name in enumerate(self.replica_ids):
-            rec = record_chunks[r // chunk]
-            gi = int(group_of[r])
-            dev = assemble(
-                rec,
-                r % chunk,
-                sorted_prep["text"][gi],
-                sorted_prep["text_pos"][gi],
-                sorted_prep["bufs"][gi],
-                g_mark[gi],
-                g_mark_pos[gi],
-                tables[r],
-                self.attrs,
+        with telemetry.span("ingest.assemble", replicas=len(self.replica_ids)):
+            if telemetry.enabled:
+                telemetry.flow_steps()
+            tables = self._batch_mark_op_table()
+            out: Dict[str, List[Dict[str, Any]]] = {}
+            assemble = (
+                assemble_patches_sorted_compact
+                if readback == "compact"
+                else assemble_patches_sorted
             )
-            merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
-            out[name] = [p for _, p in merged]
+            for r, name in enumerate(self.replica_ids):
+                rec = record_chunks[r // chunk]
+                gi = int(group_of[r])
+                dev = assemble(
+                    rec,
+                    r % chunk,
+                    sorted_prep["text"][gi],
+                    sorted_prep["text_pos"][gi],
+                    sorted_prep["bufs"][gi],
+                    g_mark[gi],
+                    g_mark_pos[gi],
+                    tables[r],
+                    self.attrs,
+                )
+                merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
+                out[name] = [p for _, p in merged]
         return out
 
     # -- materialization ----------------------------------------------------

@@ -207,9 +207,17 @@ def stream_merge_sorted(
         br = health.breaker("device_launch")
         decision = health.ALLOW if br is None else br.admit()
         if decision == health.FASTFAIL:
+            if telemetry.enabled:
+                telemetry.record("stream.launch", outcome="fastfail", lo=lo)
             raise health.BreakerOpenError("device_launch")
         hi = min(lo + cohort, r_total)
+        # One causal lane per cohort: start at launch (H2D + dispatch),
+        # finish at drain (the readback barrier) — in Perfetto the lanes'
+        # arrows crossing each other ARE the pipeline overlap the depth>1
+        # design claims.
+        ctx = telemetry.flow("stream.cohort", lo=lo, hi=hi) if telemetry.enabled else None
         with telemetry.span("stream.launch", lo=lo, hi=hi):
+            telemetry.flow_point(ctx)
             try:
                 faults.fire("device_launch")
                 st = jax.tree.map(lambda a: pad(a, lo, hi), host_states)
@@ -227,11 +235,19 @@ def stream_merge_sorted(
                 )
             except BaseException as exc:
                 _record(br, exc)
+                if telemetry.enabled:
+                    telemetry.record(
+                        "stream.launch", flow=ctx, outcome="error",
+                        error=type(exc).__name__,
+                    )
+                # The lane ends here — an unterminated flow would read as
+                # a lost cohort.
+                telemetry.flow_point(ctx, terminal=True, outcome="error")
                 raise
-        return lo, hi, out, dg, br, decision
+        return lo, hi, out, dg, br, decision, ctx
 
     def drain(entry):
-        lo, hi, out, dg, br, _decision = entry
+        lo, hi, out, dg, br, _decision, ctx = entry
         with telemetry.span("stream.drain", lo=lo, hi=hi):
             n = hi - lo
             try:
@@ -247,36 +263,58 @@ def stream_merge_sorted(
                     del out
             except BaseException as exc:
                 _record(br, exc)
+                if telemetry.enabled:
+                    telemetry.record(
+                        "stream.drain", flow=ctx, outcome="error",
+                        error=type(exc).__name__,
+                    )
+                telemetry.flow_point(ctx, terminal=True, outcome="error")
                 raise
+            # Lane terminal: the readback completed — the cohort is done.
+            telemetry.flow_point(ctx, terminal=True)
+        if ctx is not None:
+            telemetry.observe(
+                "e2e.cohort_launch_to_drain", telemetry.flow_elapsed_s(ctx)
+            )
         if br is not None:
             br.record_success()
 
     inflight: deque = deque()
     n_cohorts = 0
-    for lo in range(0, r_total, cohort):
-        entry = launch(lo)
-        n_cohorts += 1
-        if entry[-1] == health.CANARY:
-            # A half-open probe must resolve (drain = the honest readback
-            # barrier) before any further cohort is admitted: its success
-            # closes the circuit for the rest of the sweep, its failure
-            # re-opens — either way the next admit() sees the verdict
-            # instead of fast-failing behind a still-in-flight canary.
-            drain(entry)
+    try:
+        for lo in range(0, r_total, cohort):
+            entry = launch(lo)
+            n_cohorts += 1
+            if entry[5] == health.CANARY:  # the admit() decision slot
+                # A half-open probe must resolve (drain = the honest readback
+                # barrier) before any further cohort is admitted: its success
+                # closes the circuit for the rest of the sweep, its failure
+                # re-opens — either way the next admit() sees the verdict
+                # instead of fast-failing behind a still-in-flight canary.
+                drain(entry)
+                if telemetry.enabled:
+                    telemetry.counter("stream.cohorts")
+                continue
+            inflight.append(entry)
             if telemetry.enabled:
                 telemetry.counter("stream.cohorts")
-            continue
-        inflight.append(entry)
-        if telemetry.enabled:
-            telemetry.counter("stream.cohorts")
-            telemetry.gauge_max("stream.inflight_max", len(inflight))
-        # Keep `depth` cohorts in flight: the next cohort's H2D and merge
-        # are dispatched (async) before this readback blocks, so the DMA
-        # engines overlap the compute on hardware.
-        while len(inflight) >= depth:
+                telemetry.gauge_max("stream.inflight_max", len(inflight))
+            # Keep `depth` cohorts in flight: the next cohort's H2D and merge
+            # are dispatched (async) before this readback blocks, so the DMA
+            # engines overlap the compute on hardware.
+            while len(inflight) >= depth:
+                drain(inflight.popleft())
+        while inflight:
             drain(inflight.popleft())
-    while inflight:
-        drain(inflight.popleft())
+    except BaseException:
+        # A mid-sweep abort (failed drain, breaker fast-fail, Ctrl-C)
+        # leaves launched-but-undrained cohorts in the window; their lanes
+        # must still end or the trace reads them as lost.
+        if telemetry.enabled and inflight:
+            with telemetry.span("stream.abort", pending=len(inflight)):
+                for entry in inflight:
+                    telemetry.flow_point(entry[6], terminal=True, outcome="abort")
+        raise
 
     stats = {
         "replicas": r_total,

@@ -301,6 +301,20 @@ class CheckpointManager:
                 # costs replay time, never data.
                 if telemetry.enabled:
                     telemetry.counter("checkpoint.corrupt_fallbacks")
+                    telemetry.record(
+                        "checkpoint.restore",
+                        outcome="corrupt_fallback",
+                        generation=generation,
+                        error=type(exc).__name__,
+                    )
+                # Durability post-mortem: a corrupt generation means a torn
+                # write slipped past rename atomicity — worth a black-box
+                # dump (no-op unless PERITEXT_BLACKBOX is armed).
+                telemetry.blackbox_dump(
+                    "checkpoint_corrupt",
+                    generation=generation,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
                 _log.warning(
                     "checkpoint generation %d unreadable (%s: %s); "
                     "falling back to the previous generation",

@@ -148,12 +148,28 @@ class CircuitBreaker:
             telemetry.gauge(f"health.breaker.{self.site}.state", _STATE_NUM[state])
             telemetry.gauge("health.breaker.state", _STATE_NUM[state])
 
-    def _trip_locked(self) -> None:
+    def _trip_locked(self) -> Dict[str, Any]:
         # Jittered cool-down: deterministic given the plan seed and the
         # trip sequence (one rng draw per trip).
         span = self.cooldown * (1.0 + self.jitter * self._rng.random())
         self._open_until = self._clock() + span
         self._set_state(OPEN)
+        # A trip is the definitional post-mortem moment: the backend just
+        # crossed from "flaky" to "sick".  The ring event lands here (O(1)
+        # under our lock); the black-box dump — snapshot + file I/O — is
+        # returned to the caller to run AFTER the lock releases, so a slow
+        # disk cannot stall every concurrent admit()/record on this site
+        # behind the post-mortem write.
+        if telemetry.enabled:
+            telemetry.record(
+                "health.trip", outcome="open", breaker=self.site, cooldown_s=span
+            )
+        return {
+            "site": self.site,
+            "cooldown_s": span,
+            "consecutive_failures": self._consec,
+            "stats": dict(self.stats),
+        }
 
     def _should_trip_locked(self) -> bool:
         if self._consec >= self.threshold:
@@ -178,12 +194,18 @@ class CircuitBreaker:
                     self._stat("fastfails")
                     if telemetry.enabled:
                         telemetry.counter("health.fastfail")
+                        telemetry.record(
+                            "health.fastfail", outcome="open", breaker=self.site
+                        )
                     return FASTFAIL
             if self.state == HALF_OPEN:
                 if self._canary_inflight:
                     self._stat("fastfails")
                     if telemetry.enabled:
                         telemetry.counter("health.fastfail")
+                        telemetry.record(
+                            "health.fastfail", outcome="canary_inflight", breaker=self.site
+                        )
                     return FASTFAIL
                 self._canary_inflight = True
                 return CANARY
@@ -206,6 +228,7 @@ class CircuitBreaker:
 
     def record_failure(self) -> None:
         """A launch attempt failed with a transient (retryable) error."""
+        dump_info: Optional[Dict[str, Any]] = None
         with self._lock:
             self._stat("failures")
             self._consec += 1
@@ -214,10 +237,14 @@ class CircuitBreaker:
                 # The canary failed: back to open with a fresh cool-down.
                 self._canary_inflight = False
                 self._stat("canary_failures")
-                self._trip_locked()
+                dump_info = self._trip_locked()
             elif self.state == CLOSED and self._should_trip_locked():
                 self._stat("trips")
-                self._trip_locked()
+                dump_info = self._trip_locked()
+        if dump_info is not None:
+            # Post-mortem dump outside the breaker lock (no-op unless
+            # PERITEXT_BLACKBOX is armed; names the tripped site).
+            telemetry.blackbox_dump("breaker_trip", **dump_info)
 
     def abandon(self) -> None:
         """Release a canary slot without recording an outcome (the launch

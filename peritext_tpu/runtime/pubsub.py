@@ -45,16 +45,48 @@ class Publisher(Generic[T]):
         # Delivered vs dropped/duplicated/reordered/held-back: the chaos
         # outcomes mirror from FaultPlan._stat (faults.pubsub_deliver.*);
         # this site counts what actually reached callbacks.
-        if telemetry.enabled:
-            telemetry.counter("pubsub.published")
-        for key, callback in list(self._subscribers.items()):
-            if key == sender:
-                continue
-            # Per-subscriber stream: drop/dup/reorder decisions (and the
-            # holdback buffer) are independent per receiver, like real
-            # per-link network chaos.
-            for delivered in faults.filter_stream("pubsub_deliver", [update], stream=key):
-                faults.fire("pubsub_deliver")
-                if telemetry.enabled:
-                    telemetry.counter("pubsub.delivered")
-                callback(delivered)
+        if not telemetry.enabled:
+            # Disabled fast path: the untraced loop, one attr check paid.
+            for key, callback in list(self._subscribers.items()):
+                if key == sender:
+                    continue
+                for delivered in faults.filter_stream(
+                    "pubsub_deliver", [update], stream=key
+                ):
+                    faults.fire("pubsub_deliver")
+                    callback(delivered)
+            return
+        # Traced path: one causal lane per publish, a step per delivery
+        # (the subscriber callbacks run with the lane scoped onto this
+        # thread, so their ingest seams join it), terminated when the
+        # fan-out completes.  e2e.publish_to_delivered is fed per delivery
+        # — the reorder/holdback chaos makes per-receiver latency the
+        # interesting number.
+        telemetry.counter("pubsub.published")
+        ctx = telemetry.flow("pubsub.publish", sender=sender)
+        with telemetry.span("pubsub.publish", sender=sender):
+            telemetry.flow_point(ctx)
+            try:
+                for key, callback in list(self._subscribers.items()):
+                    if key == sender:
+                        continue
+                    # Per-subscriber stream: drop/dup/reorder decisions (and
+                    # the holdback buffer) are independent per receiver, like
+                    # real per-link network chaos.
+                    for delivered in faults.filter_stream(
+                        "pubsub_deliver", [update], stream=key
+                    ):
+                        faults.fire("pubsub_deliver")
+                        telemetry.counter("pubsub.delivered")
+                        with telemetry.span("pubsub.deliver", subscriber=key):
+                            telemetry.flow_point(ctx, subscriber=key)
+                            with telemetry.flowing((ctx,)):
+                                callback(delivered)
+                        telemetry.observe(
+                            "e2e.publish_to_delivered",
+                            telemetry.flow_elapsed_s(ctx),
+                        )
+            finally:
+                # The lane finishes even when a subscriber raises — an
+                # unterminated flow would read as a lost change.
+                telemetry.flow_point(ctx, terminal=True)

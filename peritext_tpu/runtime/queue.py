@@ -116,6 +116,11 @@ class ChangeQueue:
         # tracked flattened depth, so admission never rescans the queue.
         self._changes: Deque[Any] = deque()
         self._depth = 0
+        # Causal-flow lanes for the pending entries: one TraceContext per
+        # enqueue call (batch granularity, like every instrumented site),
+        # popped wholesale by the flush that drains them.  Only populated
+        # while telemetry is enabled.
+        self._flows: List[Any] = []
         self._handle_flush = handle_flush
         self._interval = interval
         self._timer: Optional[threading.Timer] = None
@@ -155,22 +160,46 @@ class ChangeQueue:
         every change is admitted (one lock hold, FIFO-contiguous) or — the
         block policy's timeout — none is, so callers can safely retry a
         QueueFullError without duplicating a half-admitted prefix."""
+        if telemetry.enabled and changes:
+            self._enqueue_traced(changes)
+            return
         with self._drained:
-            if not self._bound:
-                self._changes.extend(changes)
-                self._depth += len(changes)
-            elif self._policy == "block":
-                self._admit_blocking_locked(changes)
-            elif self._policy == "shed":
-                self._admit_shedding_locked(changes)
-            else:
-                for change in changes:
-                    self._admit_coalescing_locked(change)
-            depth = self._depth
+            self._admit_locked(changes)
+
+    def _admit_locked(self, changes: tuple) -> None:
+        if not self._bound:
+            self._changes.extend(changes)
+            self._depth += len(changes)
+        elif self._policy == "block":
+            self._admit_blocking_locked(changes)
+        elif self._policy == "shed":
+            self._admit_shedding_locked(changes)
+        else:
+            for change in changes:
+                self._admit_coalescing_locked(change)
+
+    def _enqueue_traced(self, changes: tuple) -> None:
+        """Traced admission.  The lane's start event is emitted BEFORE the
+        context is published to the flush side, and publication happens in
+        the SAME lock hold that admits the changes — so a timer flush
+        racing this enqueue can neither drain the batch without its lane
+        nor emit the lane's steps ahead of its start."""
+        ctx = telemetry.flow("queue.change", queue=self._name, changes=len(changes))
+        with telemetry.span("queue.enqueue", changes=len(changes)):
+            telemetry.flow_point(ctx)
+            try:
+                with self._drained:
+                    self._admit_locked(changes)
+                    self._flows.append(ctx)
+                    depth = self._depth
+            except BaseException:
+                # Nothing was admitted (block-timeout): the lane ends here
+                # instead of dangling as an orphan start.
+                telemetry.flow_point(ctx, terminal=True, outcome="rejected")
+                raise
         # High-water mark at enqueue time, not just flush time: depth built
         # up between flushes (a wedged handler) must be visible.
-        if telemetry.enabled:
-            telemetry.gauge_max("queue.depth_max", depth)
+        telemetry.gauge_max("queue.depth_max", depth)
 
     def _admit_blocking_locked(self, changes: tuple) -> None:
         """Wait until the whole batch fits (or the queue is empty — a batch
@@ -207,6 +236,10 @@ class ChangeQueue:
         self._depth += n
 
     def _admit_shedding_locked(self, changes: tuple) -> None:
+        # Causal lanes are per enqueue CALL, so shedding individual entries
+        # cannot unmap "their" lane; shed batches' lanes terminate at the
+        # next flush (their e2e then includes shed residency — an accepted
+        # approximation of an explicitly lossy, telemetry-flagged policy).
         self._changes.extend(changes)
         self._depth += len(changes)
         shed = 0
@@ -215,6 +248,17 @@ class ChangeQueue:
             self._depth -= shed_n
             shed += shed_n
         if shed:
+            # The entry bound just dropped data, so the lane list must not
+            # keep growing either (CLAUDE.md: "Memory stays flat under a
+            # wedged backend").  Terminate the oldest lanes down to the
+            # bound — their changes are the ones most likely shed — with an
+            # explicit "shed" outcome and no e2e observation.  We are
+            # inside the traced enqueue's span (or emitting is a no-op
+            # untraced), so the finish events stay bound.
+            while len(self._flows) > self._bound:
+                telemetry.flow_point(
+                    self._flows.pop(0), terminal=True, outcome="shed"
+                )
             if telemetry.enabled:
                 telemetry.counter("queue.shed", shed)
             _log.warning(
@@ -280,6 +324,7 @@ class ChangeQueue:
         with self._flush_lock:
             with self._drained:
                 entries, self._changes = self._changes, deque()
+                flows, self._flows = self._flows, []
                 self._depth = 0
                 self._drained.notify_all()
             changes = _flatten(entries)
@@ -307,13 +352,46 @@ class ChangeQueue:
                 changes = faults.filter_stream(
                     "queue_flush", changes, stream=self._name
                 )
-                self._handle_flush(changes)
                 if record:
+                    # The flush span is the lanes' hand-off slice: every
+                    # pending lane steps through it, the handler runs with
+                    # the lanes scoped onto this thread (so ingest seams
+                    # join them), and handler success is the terminal seam
+                    # — it feeds e2e.enqueue_to_applied and finishes the
+                    # flow.
+                    with telemetry.span("queue.flush", depth=depth):
+                        for ctx in flows:
+                            telemetry.flow_point(ctx)
+                        with telemetry.flowing(flows):
+                            self._handle_flush(changes)
+                        for ctx in flows:
+                            if ctx is not None:
+                                telemetry.observe(
+                                    "e2e.enqueue_to_applied",
+                                    telemetry.flow_elapsed_s(ctx),
+                                )
+                                telemetry.flow_point(ctx, terminal=True)
+                    telemetry.record(
+                        "queue.flush", outcome="applied", depth=depth
+                    )
                     telemetry.counter("queue.flushes")
                     telemetry.observe("queue.flush_depth", depth)
                     telemetry.observe(
                         "queue.flush_seconds", time.perf_counter() - t0
                     )
+                else:
+                    self._handle_flush(changes)
+                    if flows and telemetry.enabled:
+                        # Lanes popped with no recordable batch (every
+                        # entry was shed, or telemetry toggled between
+                        # enqueue and flush): terminate them without an
+                        # e2e observation — a dropped lane must still end,
+                        # never dangle as an orphan start.
+                        with telemetry.span("queue.flush_dropped", flows=len(flows)):
+                            for ctx in flows:
+                                telemetry.flow_point(
+                                    ctx, terminal=True, outcome="dropped"
+                                )
             except BaseException:
                 # A failed flush must not lose the batch: put the surviving
                 # changes back at the front so a later flush retries them
@@ -322,11 +400,17 @@ class ChangeQueue:
                 # batch — FIFO holds across the failure; pinned by
                 # tests/test_faults.py).  Deliberately past the bound: the
                 # batch was admitted once and must not be re-judged.
+                # The lanes ride along: the retry flush that finally lands
+                # is what finishes them.
                 with self._lock:
                     self._changes.extendleft(reversed(changes))
                     self._depth += len(changes)
+                    self._flows[:0] = flows
                 if record:
                     telemetry.counter("queue.reenqueues", len(changes))
+                    telemetry.record(
+                        "queue.flush", outcome="error", depth=depth
+                    )
                 raise
 
     def _tick(self, epoch: int) -> None:

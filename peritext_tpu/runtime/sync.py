@@ -55,34 +55,43 @@ def apply_available(
     pending = deque(changes)
     patches: List[Dict[str, Any]] = []
     stuck = 0
-    while pending:
-        change = pending.popleft()
-        if change["seq"] <= doc.clock.get(change["actor"], 0):
-            continue  # duplicate delivery: already applied
-        try:
-            patches.extend(doc.apply_change(change))
-            stuck = 0
-        except ValueError:
-            pending.append(change)
-            stuck += 1
-            if stuck >= len(pending):
-                break
-        except Exception as exc:
-            # Non-causal failure mid-batch (backend error, malformed
-            # change): earlier changes DID apply and their patches must not
-            # be lost, but a function cannot both return and raise — tag the
-            # exception with the partial progress so consumers with retry
-            # buffers (the Editor) can keep it, and put the failing change
-            # back at the front for redelivery-free retry.
-            pending.appendleft(change)
-            exc.applied_patches = patches  # type: ignore[attr-defined]
-            exc.unapplied = list(pending)  # type: ignore[attr-defined]
-            raise
-    if pending and telemetry.enabled:
-        # Chaotic-delivery accounting: how many causally-unready changes
-        # each gap-tolerant pass handed back (allow_gaps consumers leave
-        # them for a later anti-entropy redelivery).
-        telemetry.counter("sync.deferred", len(pending))
+    # Causal-flow seam: the retry-queue walk runs inside a span so any lane
+    # scoped onto this thread (a pubsub delivery, a queue flush) steps
+    # through it — this is where a chaotically-delivered change either
+    # applies or defers, exactly the fate a per-change trace must show.
+    with telemetry.span("sync.apply", changes=len(pending)):
+        if telemetry.enabled:
+            telemetry.flow_steps()
+        while pending:
+            change = pending.popleft()
+            if change["seq"] <= doc.clock.get(change["actor"], 0):
+                continue  # duplicate delivery: already applied
+            try:
+                patches.extend(doc.apply_change(change))
+                stuck = 0
+            except ValueError:
+                pending.append(change)
+                stuck += 1
+                if stuck >= len(pending):
+                    break
+            except Exception as exc:
+                # Non-causal failure mid-batch (backend error, malformed
+                # change): earlier changes DID apply and their patches must not
+                # be lost, but a function cannot both return and raise — tag the
+                # exception with the partial progress so consumers with retry
+                # buffers (the Editor) can keep it, and put the failing change
+                # back at the front for redelivery-free retry.
+                pending.appendleft(change)
+                exc.applied_patches = patches  # type: ignore[attr-defined]
+                exc.unapplied = list(pending)  # type: ignore[attr-defined]
+                raise
+        if pending and telemetry.enabled:
+            # Chaotic-delivery accounting: how many causally-unready changes
+            # each gap-tolerant pass handed back (allow_gaps consumers leave
+            # them for a later anti-entropy redelivery).
+            telemetry.counter("sync.deferred", len(pending))
+            telemetry.record("sync.defer", outcome="deferred", count=len(pending))
+            telemetry.flow_steps(deferred=len(pending))
     return patches, list(pending)
 
 

@@ -17,7 +17,25 @@ reports through:
   monotonic microsecond timestamps and the recording thread's id, so
   nested spans render as flame stacks per thread in Perfetto /
   chrome://tracing.  Every span also lands in the registry as a
-  ``span.<name>.seconds`` histogram.
+  ``span.<name>.seconds`` histogram;
+- a **causal flow plane**: a :class:`TraceContext` (monotonic id — no
+  wall-clock entropy) is minted where a change enters the system
+  (``ChangeQueue.enqueue``, ``TpuDoc.change``, ``Publisher.publish``,
+  cohort launch) and threaded through every seam it crosses, emitting
+  Chrome *flow events* (``ph: s/t/f``) bound to the enclosing span, so
+  Perfetto draws one arrow-connected lane per change across threads.
+  The terminal seam feeds end-to-end latency histograms
+  (``e2e.enqueue_to_applied``, ``e2e.publish_to_delivered``, ...).
+  Propagation is thread-local (:func:`flowing` / :func:`current_flows`)
+  so deep seams (ingest retries, degradation, readback) join the lane
+  without threading a context argument through every signature;
+- a **flight recorder**: a fixed-capacity ring of recent structured
+  events (site, flow id, outcome, µs) that is always recording while
+  telemetry is enabled.  On a failure worth a post-mortem (launch-budget
+  exhaustion, breaker trip, checkpoint corruption, unhandled ingest
+  exception) :func:`blackbox_dump` writes the ring + a registry snapshot
+  to ``PERITEXT_BLACKBOX=<dir>`` — the post-mortem for the wedged-relay
+  failure mode where the atexit-only dump dies with the process.
 
 Activation
 ==========
@@ -26,9 +44,14 @@ Activation
 per line; wrap with ``jq -s . trace.jsonl > trace.json`` for
 chrome://tracing — Perfetto's importer reads the newline-delimited form
 directly).  ``PERITEXT_METRICS=<path>`` dumps a JSON metrics snapshot at
-interpreter exit.  Either env var enables collection at import; tests and
-embedders call :func:`enable` / :func:`disable` / :func:`reset`
-programmatically.
+interpreter exit; ``PERITEXT_METRICS_INTERVAL=<secs>`` additionally
+flushes that snapshot periodically from a daemon thread (atomic
+tmp+rename), so a SIGKILLed/timed-out child leaves a recent snapshot
+instead of nothing.  ``PERITEXT_BLACKBOX=<dir>`` arms the flight
+recorder's failure dumps (``PERITEXT_BLACKBOX_RING`` sizes the ring,
+default 512 events).  Any of these env vars enables collection at
+import; tests and embedders call :func:`enable` / :func:`disable` /
+:func:`reset` programmatically.
 
 The overhead contract
 =====================
@@ -59,12 +82,13 @@ nested or cross-thread spans cannot corrupt one another.
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import math
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # NOTE: `enabled` is deliberately NOT in __all__ — `from telemetry import
 # enabled` would snapshot the flag at import time and make guards
@@ -84,6 +108,20 @@ __all__ = [
     "dump_metrics",
     "flush_trace",
     "trace_path",
+    "TraceContext",
+    "flow",
+    "flow_point",
+    "flow_steps",
+    "flowing",
+    "current_flows",
+    "current_flow",
+    "flow_elapsed_s",
+    "record",
+    "recorder_events",
+    "recorder_stats",
+    "blackbox_dir",
+    "blackbox_dump",
+    "estimate_quantiles",
 ]
 
 # THE hot-path gate (see the overhead contract above).
@@ -142,6 +180,54 @@ class _Histogram:
                 if c
             },
         }
+
+
+def estimate_quantiles(
+    hist_json: Dict[str, Any], qs: Sequence[float] = (0.5, 0.95, 0.99)
+) -> Optional[Dict[str, float]]:
+    """p-quantile estimates from a snapshot-format log2-bucket histogram.
+
+    Works on the JSON form (so dumped snapshots and live ones estimate
+    identically): each nominal bucket "e" holds values in [2**(e-1), 2**e);
+    the estimate is the bucket's geometric midpoint, clamped to the
+    histogram's observed [min, max].  The clamped end buckets estimate at
+    the observed extreme on their side.  Returns {"p50": v, ...} or None
+    for an empty histogram.
+    """
+    count = hist_json.get("count", 0)
+    if not count:
+        return None
+    vmin, vmax = hist_json["min"], hist_json["max"]
+
+    def bucket_key(item: Tuple[str, int]) -> int:
+        k = item[0]
+        if k == "<=-32":
+            return -(10**6)
+        if k == ">=31":
+            return 10**6
+        return int(k)
+
+    buckets = sorted(hist_json["buckets"].items(), key=bucket_key)
+    out: Dict[str, float] = {}
+    for q in qs:
+        target = q * count
+        cum = 0
+        est = vmax
+        for k, c in buckets:
+            cum += c
+            if cum >= target:
+                if k == "<=-32":
+                    est = vmin
+                elif k == ">=31":
+                    est = vmax
+                else:
+                    est = 2.0 ** (int(k) - 0.5)  # geometric bucket midpoint
+                break
+        # %g keeps the label faithful to the requested quantile: 0.5 ->
+        # "p50", 0.29 -> "p29" (int() would float-truncate to "p28"),
+        # 0.999 -> "p99.9" (distinct from "p99", no silent collision).
+        out["p%g" % (q * 100)] = min(max(est, vmin), vmax)
+    return out
 
 
 class Registry:
@@ -240,6 +326,36 @@ class _Tracer:
             event["args"] = args
         self._emit(event)
 
+    def emit_flow(
+        self,
+        name: str,
+        phase: str,
+        flow_id: int,
+        ts_us: float,
+        tid: int,
+        args: Optional[Dict[str, Any]],
+    ) -> None:
+        """One Chrome flow event (ph s/t/f).  Binding rule: the event
+        attaches to the slice covering (pid, tid, ts) — callers emit from
+        inside an open span, whose complete event (written later, at span
+        exit) covers this timestamp."""
+        event: Dict[str, Any] = {
+            "name": name,
+            "cat": "peritext.flow",
+            "ph": phase,
+            "id": flow_id,
+            "ts": ts_us,
+            "pid": os.getpid(),
+            "tid": tid,
+        }
+        if phase == "f":
+            # Bind the finish to the ENCLOSING slice (the default binds to
+            # the next slice that begins, which here would be arbitrary).
+            event["bp"] = "e"
+        if args:
+            event["args"] = args
+        self._emit(event)
+
     def _flush_locked(self) -> None:
         if self._buf and self._file is not None:
             self._file.write("\n".join(self._buf) + "\n")
@@ -303,6 +419,133 @@ class _Span:
         return False
 
 
+# -- causal flow contexts -----------------------------------------------------
+
+# Monotonic flow ids: allocation order IS causal mint order, deterministic
+# given call order (no Date.now()-style wall entropy), and distinct across
+# every plane in the process.
+_flow_ids = itertools.count(1)
+_flow_lock = threading.Lock()
+_tls = threading.local()
+
+
+class TraceContext:
+    """One change-batch's causal identity, threaded across seams.
+
+    ``id`` is the Chrome flow-event id; ``kind`` names the lane (the flow
+    events' shared name); ``t0_ns`` is the mint time (perf_counter), so the
+    terminal seam can feed the e2e latency histograms.  The phase machine
+    (unstarted -> started -> finished) makes emission idempotent-safe: the
+    first :func:`flow_point` emits ``s``, later ones ``t``, the terminal
+    one ``f``, and anything after a finish is ignored — a retried flush
+    cannot corrupt the triplet.
+    """
+
+    __slots__ = ("id", "kind", "t0_ns", "meta", "_phase")
+
+    def __init__(self, kind: str, meta: Optional[Dict[str, Any]] = None) -> None:
+        self.id = next(_flow_ids)
+        self.kind = kind
+        self.t0_ns = time.perf_counter_ns()
+        self.meta = meta
+        self._phase = 0  # 0 unstarted, 1 started, 2 finished
+
+
+class _Flowing:
+    """Scoped thread-local flow propagation (replace semantics: the inner
+    scope's lanes are what downstream seams join)."""
+
+    __slots__ = ("ctxs", "prev")
+
+    def __init__(self, ctxs: Tuple["TraceContext", ...]) -> None:
+        self.ctxs = ctxs
+        self.prev: Tuple["TraceContext", ...] = ()
+
+    def __enter__(self) -> "_Flowing":
+        self.prev = getattr(_tls, "flows", ())
+        _tls.flows = self.ctxs
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _tls.flows = self.prev
+        return False
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+class _FlightRecorder:
+    """Fixed-capacity ring of recent structured events.
+
+    Preallocated slots, one lock, O(1) per record; overwrites count as
+    ``dropped`` so post-mortems know how much history the ring held vs
+    lost.  Never grows — the always-on cost is bounded by construction.
+    """
+
+    __slots__ = ("cap", "buf", "n", "dropped", "lock")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = max(1, cap)
+        self.buf: List[Any] = [None] * self.cap
+        self.n = 0
+        self.dropped = 0
+        self.lock = threading.Lock()
+
+    def record(
+        self,
+        t_us: float,
+        site: str,
+        flow_id: Optional[int],
+        outcome: str,
+        fields: Optional[Dict[str, Any]],
+    ) -> None:
+        with self.lock:
+            if self.n >= self.cap:
+                self.dropped += 1
+            self.buf[self.n % self.cap] = (t_us, site, flow_id, outcome, fields)
+            self.n += 1
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self.lock:
+            if self.n <= self.cap:
+                items = list(self.buf[: self.n])
+            else:
+                i = self.n % self.cap
+                items = list(self.buf[i:]) + list(self.buf[:i])
+        out = []
+        for t_us, site, flow_id, outcome, fields in items:
+            event: Dict[str, Any] = {"ts_us": t_us, "site": site, "outcome": outcome}
+            if flow_id is not None:
+                event["flow"] = flow_id
+            if fields:
+                event["fields"] = fields
+            out.append(event)
+        return out
+
+
+class _MetricsFlusher(threading.Thread):
+    """Periodic metrics-snapshot flush (PERITEXT_METRICS_INTERVAL): the
+    atexit dump dies exactly when it matters most (SIGKILLed bench child,
+    wedged-relay timeout); this daemon leaves a recent atomic snapshot
+    behind instead."""
+
+    def __init__(self, interval: float) -> None:
+        super().__init__(daemon=True, name="peritext-metrics-flusher")
+        self.interval = interval
+        self.stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self.stop_event.wait(self.interval):
+            try:
+                dump_metrics()
+            except Exception:  # a full disk must not kill the flusher
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "periodic metrics flush failed", exc_info=True
+                )
+
+
 # -- the process-wide plane ---------------------------------------------------
 
 _registry = Registry()
@@ -310,6 +553,11 @@ _tracer: Optional[_Tracer] = None
 _metrics_path: Optional[str] = None
 _config_lock = threading.Lock()
 _atexit_registered = False
+_recorder: Optional[_FlightRecorder] = None
+_blackbox_dir: Optional[str] = None
+_blackbox_seq = itertools.count(1)
+_MAX_BLACKBOX_DUMPS = 32
+_flusher: Optional[_MetricsFlusher] = None
 
 
 def counter(name: str, n: int = 1) -> None:
@@ -345,6 +593,178 @@ def span(name: str, **args: Any) -> Any:
     return _Span(name, args or None)
 
 
+def flow(kind: str, **meta: Any) -> Optional[TraceContext]:
+    """Mint a causal trace context (None while disabled — call sites keep
+    the one-attr-check contract by guarding on ``telemetry.enabled``).
+    ``meta`` rides on the flow's start event (change ids, actor, count)."""
+    if not enabled:
+        return None
+    return TraceContext(kind, meta or None)
+
+
+def flow_point(
+    ctx: Optional[TraceContext], terminal: bool = False, **args: Any
+) -> None:
+    """Mark the current seam on a flow's lane (no-op for None / no tracer).
+
+    MUST be called from inside an open :func:`span` — flow events bind to
+    the slice covering their timestamp on this thread.  The first point
+    emits the flow start (``s``), later ones steps (``t``), and
+    ``terminal=True`` the finish (``f``); points after a finish are
+    dropped, so retried seams cannot emit a second finish."""
+    if ctx is None:
+        return
+    tracer = _tracer
+    if tracer is None:
+        return
+    now_us = time.perf_counter_ns() / 1e3
+    with _flow_lock:
+        phase0 = ctx._phase
+        if phase0 == 2:
+            return
+        start = phase0 == 0
+        ctx._phase = 2 if terminal else 1
+    tid = threading.get_ident()
+    if start:
+        tracer.emit_flow(ctx.kind, "s", ctx.id, now_us, tid, ctx.meta)
+    if terminal:
+        tracer.emit_flow(ctx.kind, "f", ctx.id, now_us, tid, args or None)
+    elif not start:
+        tracer.emit_flow(ctx.kind, "t", ctx.id, now_us, tid, args or None)
+
+
+def flow_steps(terminal: bool = False, **args: Any) -> None:
+    """flow_point for every lane propagated onto this thread (deep seams —
+    ingest attempts, degradation, readback — join whatever lanes the
+    enclosing flush/change/delivery scoped in via :func:`flowing`)."""
+    for ctx in getattr(_tls, "flows", ()):
+        flow_point(ctx, terminal=terminal, **args)
+
+
+def flowing(ctxs: Sequence[Optional[TraceContext]]) -> Any:
+    """Scope flow contexts onto this thread for downstream seams.  Returns
+    an allocation-free no-op for an empty/None-only sequence, so disabled
+    call sites pay nothing."""
+    live = tuple(c for c in ctxs if c is not None)
+    if not live:
+        return _NULL_SPAN
+    return _Flowing(live)
+
+
+def current_flows() -> Tuple[TraceContext, ...]:
+    """The lanes scoped onto this thread (empty tuple when none)."""
+    return getattr(_tls, "flows", ())
+
+
+def current_flow() -> Optional[TraceContext]:
+    """The first lane scoped onto this thread, or None — the one to stamp
+    on single-flow recorder events."""
+    flows = getattr(_tls, "flows", ())
+    return flows[0] if flows else None
+
+
+def flow_elapsed_s(ctx: TraceContext) -> float:
+    """Seconds since the context was minted (feeds the e2e histograms)."""
+    return (time.perf_counter_ns() - ctx.t0_ns) / 1e9
+
+
+def record(
+    site: str,
+    flow: Optional[TraceContext] = None,
+    outcome: str = "ok",
+    **fields: Any,
+) -> None:
+    """Append one structured event to the flight-recorder ring (no-op
+    while disabled).  Launch-level granularity, like every other site."""
+    if not enabled:
+        return
+    rec = _recorder
+    if rec is None:
+        rec = _ensure_recorder()
+    rec.record(
+        time.perf_counter_ns() / 1e3,
+        site,
+        None if flow is None else flow.id,
+        outcome,
+        fields or None,
+    )
+
+
+def _ensure_recorder() -> _FlightRecorder:
+    global _recorder
+    with _config_lock:
+        if _recorder is None:
+            try:
+                cap = int(os.environ.get("PERITEXT_BLACKBOX_RING", "512") or 512)
+            except ValueError:
+                cap = 512
+            _recorder = _FlightRecorder(cap)
+        return _recorder
+
+
+def recorder_events() -> List[Dict[str, Any]]:
+    """The ring's events, oldest first (empty when nothing recorded)."""
+    rec = _recorder
+    return [] if rec is None else rec.events()
+
+
+def recorder_stats() -> Tuple[int, int]:
+    """(events recorded, events dropped by ring overwrite)."""
+    rec = _recorder
+    return (0, 0) if rec is None else (rec.n, rec.dropped)
+
+
+def blackbox_dir() -> Optional[str]:
+    """The armed black-box dump directory, or None."""
+    return _blackbox_dir
+
+
+def blackbox_dump(reason: str, **info: Any) -> Optional[str]:
+    """Write a post-mortem dump (ring + registry snapshot + summary) to the
+    ``PERITEXT_BLACKBOX`` directory; returns the path or None when unarmed.
+
+    Atomic (tmp+rename), monotonic per-process sequence numbers, and capped
+    at a few dozen dumps per process so a wedge storm cannot fill the disk
+    (skips count as ``blackbox.skipped``).  Never raises — a full disk must
+    not turn a post-mortem into a second failure."""
+    d = _blackbox_dir
+    if d is None:
+        return None
+    seq = next(_blackbox_seq)
+    if seq > _MAX_BLACKBOX_DUMPS:
+        if enabled:
+            _registry.counter("blackbox.skipped")
+        return None
+    rec = _recorder
+    payload = {
+        "reason": reason,
+        "info": info,
+        "pid": os.getpid(),
+        "ring": [] if rec is None else rec.events(),
+        "ring_dropped": 0 if rec is None else rec.dropped,
+        "metrics": snapshot(),
+        "summary": summary(),
+    }
+    path = os.path.join(d, f"blackbox-{os.getpid()}-{seq:04d}-{reason}.json")
+    tmp = path + ".tmp"
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "black-box dump to %r failed", path, exc_info=True
+        )
+        return None
+    if enabled:
+        _registry.counter("blackbox.dumps")
+    flush_trace()  # the trace should cover everything the dump names
+    return path
+
+
 def snapshot() -> Dict[str, Any]:
     """Full registry contents: {"counters", "gauges", "histograms"}."""
     return _registry.snapshot()
@@ -377,6 +797,8 @@ def summary() -> Dict[str, Any]:
         ("stream_cohorts", "stream.cohorts"),
         ("checkpoint_corrupt_fallbacks", "checkpoint.corrupt_fallbacks"),
         ("local_gen_rollbacks", "doc.local_gen_rollbacks"),
+        ("blackbox_dumps", "blackbox.dumps"),
+        ("blackbox_skipped", "blackbox.skipped"),
     ):
         if src in counters:
             out[key] = counters[src]
@@ -405,6 +827,35 @@ def summary() -> Dict[str, Any]:
     }
     if health_mirror:
         out["health"] = health_mirror
+    # End-to-end latency percentiles (the causal-flow plane's terminal
+    # seams) + the key per-seam latencies, estimated from the log2
+    # histograms — the "why was p99 40x the median" numbers a one-line
+    # bench stamp or chaos footer can carry.
+    hists = snap["histograms"]
+    e2e = {}
+    for name, h in hists.items():
+        if name.startswith("e2e."):
+            q = estimate_quantiles(h)
+            if q is not None:
+                q["count"] = h["count"]
+                e2e[name[len("e2e.") :]] = q
+    if e2e:
+        out["e2e"] = e2e
+    lat = {}
+    for label, src in (
+        ("ingest_launch_s", "span.ingest.launch_attempt.seconds"),
+        ("queue_flush_s", "queue.flush_seconds"),
+    ):
+        if src in hists:
+            q = estimate_quantiles(hists[src])
+            if q is not None:
+                lat[label] = q
+    if lat:
+        out["latency"] = lat
+    rec_n, rec_dropped = recorder_stats()
+    if rec_n:
+        out["recorder_events"] = rec_n
+        out["recorder_dropped"] = rec_dropped
     return out
 
 
@@ -414,12 +865,18 @@ def trace_path() -> Optional[str]:
     return None if tracer is None else tracer.path
 
 
-def enable(trace: Optional[str] = None, metrics: Optional[str] = None) -> None:
+def enable(
+    trace: Optional[str] = None,
+    metrics: Optional[str] = None,
+    blackbox: Optional[str] = None,
+    metrics_interval: Optional[float] = None,
+) -> None:
     """Turn collection on.  ``trace`` opens (truncating) a Chrome trace
-    JSONL file; ``metrics`` schedules a snapshot dump at interpreter exit.
-    Either may be omitted — a bare ``enable()`` collects registry metrics
-    only."""
-    global enabled, _tracer, _metrics_path
+    JSONL file; ``metrics`` schedules a snapshot dump at interpreter exit
+    (``metrics_interval`` > 0 additionally flushes it periodically from a
+    daemon thread); ``blackbox`` arms failure dumps to a directory.  All
+    may be omitted — a bare ``enable()`` collects registry metrics only."""
+    global enabled, _tracer, _metrics_path, _blackbox_dir, _flusher
     with _config_lock:
         if trace:
             if _tracer is not None and _tracer.path != trace:
@@ -429,8 +886,17 @@ def enable(trace: Optional[str] = None, metrics: Optional[str] = None) -> None:
                 _tracer = _Tracer(trace)
         if metrics:
             _metrics_path = metrics
+        if blackbox:
+            _blackbox_dir = blackbox
         _ensure_atexit_locked()
         enabled = True
+        if metrics_interval and metrics_interval > 0 and _metrics_path:
+            if _flusher is not None and _flusher.interval != metrics_interval:
+                _flusher.stop_event.set()
+                _flusher = None
+            if _flusher is None:
+                _flusher = _MetricsFlusher(metrics_interval)
+                _flusher.start()
 
 
 def disable() -> None:
@@ -442,15 +908,21 @@ def disable() -> None:
 
 def reset() -> None:
     """Back to a pristine, disabled plane: counters cleared, tracer closed,
-    exit dump canceled.  Does NOT re-read the environment (tests own the
-    lifecycle after a reset)."""
-    global enabled, _tracer, _metrics_path
+    exit dump canceled, recorder ring dropped, black-box disarmed, the
+    periodic flusher stopped.  Does NOT re-read the environment (tests own
+    the lifecycle after a reset)."""
+    global enabled, _tracer, _metrics_path, _recorder, _blackbox_dir, _flusher
     with _config_lock:
         enabled = False
         if _tracer is not None:
             _tracer.close()
             _tracer = None
         _metrics_path = None
+        _recorder = None
+        _blackbox_dir = None
+        if _flusher is not None:
+            _flusher.stop_event.set()
+            _flusher = None
         _registry.clear()
 
 
@@ -462,18 +934,27 @@ def flush_trace() -> None:
         tracer.flush()
 
 
+_dump_lock = threading.Lock()
+
+
 def dump_metrics(path: Optional[str] = None) -> Optional[str]:
-    """Write the metrics snapshot (+ summary) as JSON.  Defaults to the
-    ``PERITEXT_METRICS`` path; returns the path written or None."""
+    """Write the metrics snapshot (+ summary) as JSON, atomically.
+    Defaults to the ``PERITEXT_METRICS`` path; returns the path written or
+    None.  Serialized under a lock AND written via a per-writer tmp name:
+    the periodic flusher can race the atexit dump (or a programmatic
+    call), and two writers sharing one tmp path would rename an
+    interleaved file into place — exactly the corrupt snapshot this
+    feature exists to prevent."""
     path = path or _metrics_path
     if not path:
         return None
     payload = snapshot()
     payload["summary"] = summary()
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with _dump_lock:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
     return path
 
 
@@ -495,7 +976,8 @@ def _ensure_atexit_locked() -> None:
 
 
 def _activate_from_env() -> None:
-    """Import-time activation from PERITEXT_TRACE / PERITEXT_METRICS.
+    """Import-time activation from PERITEXT_TRACE / PERITEXT_METRICS /
+    PERITEXT_BLACKBOX (+ PERITEXT_METRICS_INTERVAL).
 
     A bad trace path (missing directory, permissions) must not take the
     whole product down at import — observability degrades to untraced
@@ -503,10 +985,20 @@ def _activate_from_env() -> None:
     raises, so deliberate callers see the real error."""
     trace = os.environ.get("PERITEXT_TRACE")
     metrics = os.environ.get("PERITEXT_METRICS")
-    if not (trace or metrics):
+    blackbox = os.environ.get("PERITEXT_BLACKBOX")
+    try:
+        interval = float(os.environ.get("PERITEXT_METRICS_INTERVAL", "0") or 0)
+    except ValueError:
+        interval = 0.0
+    if not (trace or metrics or blackbox):
         return
     try:
-        enable(trace=trace or None, metrics=metrics or None)
+        enable(
+            trace=trace or None,
+            metrics=metrics or None,
+            blackbox=blackbox or None,
+            metrics_interval=interval or None,
+        )
     except OSError as exc:
         import logging
 
@@ -515,7 +1007,11 @@ def _activate_from_env() -> None:
             trace,
             exc,
         )
-        enable(metrics=metrics or None)
+        enable(
+            metrics=metrics or None,
+            blackbox=blackbox or None,
+            metrics_interval=interval or None,
+        )
 
 
 _activate_from_env()

@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""CI drill: a seeded wedge storm must trip the breaker AND leave a
+black-box dump behind.
+
+Runs a patched-fleet ingest under an injected device_launch failure storm
+with a threshold-2 circuit breaker and PERITEXT_BLACKBOX armed, then
+asserts:
+
+- the breaker tripped and the storm batch degraded to the oracle path;
+- a black-box dump was written, parses as JSON, names the tripped site,
+  and its ring events span the failing batch (flow/trace ids present);
+- the degraded replica's text equals a fault-free control's (the existing
+  byte-identity contract, spot-checked end to end);
+- with PERITEXT_TRACE set, the flow-event graph for the run validates
+  (scripts/trace_report.py schema pass).
+
+Exit 0 on success; any assertion failure exits non-zero.  Stdlib + the
+package only — CI runs it right after the chaos/health pytest legs.
+"""
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    os.environ.setdefault("PERITEXT_LAUNCH_RETRIES", "1")
+
+    blackbox_dir = os.environ.get("PERITEXT_BLACKBOX") or tempfile.mkdtemp(
+        prefix="peritext-blackbox-"
+    )
+    trace_path = os.environ.get("PERITEXT_TRACE") or os.path.join(
+        blackbox_dir, "trip_trace.jsonl"
+    )
+
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.runtime import ChangeQueue, faults, health, telemetry
+    from peritext_tpu.runtime.faults import FaultPlan
+    from peritext_tpu.runtime.health import HealthPlan
+
+    telemetry.reset()
+    telemetry.enable(trace=trace_path, blackbox=blackbox_dir)
+
+    alice = Doc("alice")
+    genesis, _ = alice.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list("blackbox drill")},
+        ]
+    )
+    edits = []
+    for i in range(3):
+        c, _ = alice.change(
+            [{"path": ["text"], "action": "insert", "index": i, "values": ["x"]}]
+        )
+        edits.append(c)
+
+    def run(storm: bool):
+        # Changes travel the real seam chain — queue enqueue -> flush ->
+        # ingest — so every change gets a causal lane the trip's ring and
+        # trace can name.
+        uni = TpuUniverse(["r0", "r1"])
+        q = ChangeQueue(
+            lambda chs: [
+                uni.apply_changes_with_patches({"r0": [c], "r1": [c]}) for c in chs
+            ],
+            name="blackbox-drill-" + ("storm" if storm else "control"),
+        )
+        q.enqueue(genesis)
+        q.flush()
+        if storm:
+            plan = FaultPlan(seed=7).with_site("device_launch", fail=99)
+            hplan = health.install(HealthPlan(seed=7))
+            hplan.site("device_launch", threshold=2, cooldown=60, jitter=0.0)
+            with faults.injected(plan):
+                for c in edits:
+                    q.enqueue(c)
+                    q.flush()
+            health.reset()
+        else:
+            for c in edits:
+                q.enqueue(c)
+                q.flush()
+        return uni
+
+    control = run(storm=False)
+    stormed = run(storm=True)
+
+    assert stormed.stats["degraded_batches"] >= 1, stormed.stats
+    assert stormed.texts() == control.texts(), "degraded run diverged from control"
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("health.device_launch.trips", 0) >= 1, counters
+    assert counters.get("blackbox.dumps", 0) >= 1, counters
+
+    dumps = sorted(glob.glob(os.path.join(blackbox_dir, "blackbox-*.json")))
+    assert dumps, f"no black-box dump in {blackbox_dir}"
+    trip_dumps = [d for d in dumps if "breaker_trip" in os.path.basename(d)]
+    assert trip_dumps, f"no breaker_trip dump among {dumps}"
+    with open(trip_dumps[-1]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "breaker_trip"
+    assert dump["info"]["site"] == "device_launch", dump["info"]
+    ring_sites = [e["site"] for e in dump["ring"]]
+    assert "ingest.launch" in ring_sites, ring_sites
+    fails = [e for e in dump["ring"] if e["outcome"] == "fail"]
+    assert fails, "ring holds no failed-launch events for the storm batch"
+
+    telemetry.flush_trace()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+
+    events = trace_report.load_events(trace_path)
+    problems = trace_report.validate_flows(events)
+    assert not problems, problems
+    a = trace_report.analyze(events)
+    assert a["degraded_lanes"] >= 1, a
+    print(trace_report.summary_line(a))
+    print(
+        f"blackbox_trip_check: ok — trip dump {os.path.basename(trip_dumps[-1])}, "
+        f"{len(dump['ring'])} ring event(s), degraded run byte-identical"
+    )
+    telemetry.reset()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

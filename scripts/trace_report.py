@@ -1,0 +1,335 @@
+#!/usr/bin/env python3
+"""Offline causal-flow analysis of a PERITEXT_TRACE JSONL.
+
+The tracer (peritext_tpu/runtime/telemetry.py) emits one flow-event lane
+(ph s/t/f, shared id) per change batch, with every point bound to the
+enclosing span's slice.  This script reconstructs the lanes offline and
+answers the question aggregate counters cannot: *where did this change's
+time go* — queue wait vs device launch (incl. retries) vs record readback
+vs host patch assembly vs oracle degradation.
+
+Usage:
+    python scripts/trace_report.py trace.jsonl [--top K] [--json]
+
+Prints a per-phase critical-path breakdown, retry/degrade attribution, the
+top-K slowest lanes with their own breakdowns, and a final one-line
+summary (``trace_report: ...``) that bench harnesses can diff across
+rounds.  Stdlib-only: runs anywhere the JSONL lands, no JAX needed.
+"""
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+# Slice-name -> critical-path phase.  Longest prefix wins; names with no
+# entry bucket as "other".  Containment dedup (see lane_breakdown) keeps
+# the buckets non-overlapping even though e.g. queue.flush encloses the
+# ingest spans.
+PHASE_OF = (
+    ("ingest.launch_attempt", "device"),
+    ("ingest.readback", "readback"),
+    ("ingest.assemble", "assembly"),
+    ("ingest.degrade", "degrade"),
+    ("queue.enqueue", "queue_admit"),
+    ("queue.flush", "queue"),
+    ("pubsub.deliver", "deliver"),
+    ("pubsub.publish", "publish"),
+    ("sync.", "sync"),
+    ("doc.", "generate"),
+    ("stream.launch", "launch"),
+    ("stream.drain", "drain"),
+    ("checkpoint.", "checkpoint"),
+)
+
+
+def phase_of(name: str) -> str:
+    for prefix, phase in PHASE_OF:
+        if name.startswith(prefix):
+            return phase
+    return "other"
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def _slices_by_thread(events) -> Dict[Tuple[int, int], List[Dict[str, Any]]]:
+    by_thread: Dict[Tuple[int, int], List[Dict[str, Any]]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X":
+            by_thread[(e["pid"], e["tid"])].append(e)
+    for slices in by_thread.values():
+        slices.sort(key=lambda s: (s["ts"], -s["dur"]))
+    return by_thread
+
+
+def bound_slice(
+    by_thread, event: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """The innermost complete event covering this flow event's timestamp on
+    its thread (latest start among covering slices), or None (unbound)."""
+    slices = by_thread.get((event["pid"], event["tid"]), [])
+    ts = event["ts"]
+    starts = [s["ts"] for s in slices]
+    best = None
+    for i in range(bisect.bisect_right(starts, ts) - 1, -1, -1):
+        s = slices[i]
+        if s["ts"] + s["dur"] >= ts:
+            best = s
+            break  # latest-starting coverer == innermost (spans nest)
+    return best
+
+
+def validate_flows(events) -> List[str]:
+    """Schema problems in the flow-event graph (empty list == well-formed):
+    every id has exactly one start and one finish, points are causally
+    (timestamp-)ordered s <= t* <= f — i.e. the per-lane graph is acyclic —
+    and every flow event binds to a covering slice on its thread."""
+    problems: List[str] = []
+    by_thread = _slices_by_thread(events)
+    lanes: Dict[int, Dict[str, Any]] = defaultdict(
+        lambda: {"s": [], "t": [], "f": [], "names": set()}
+    )
+    for e in events:
+        if e.get("ph") in ("s", "t", "f"):
+            lanes[e["id"]][e["ph"]].append(e)
+            lanes[e["id"]]["names"].add(e["name"])
+            if bound_slice(by_thread, e) is None:
+                problems.append(f"flow {e['id']}: unbound {e['ph']} event at ts={e['ts']}")
+    for fid, lane in sorted(lanes.items()):
+        if len(lane["s"]) != 1:
+            problems.append(f"flow {fid}: {len(lane['s'])} start events (want 1)")
+        if len(lane["f"]) != 1:
+            problems.append(f"flow {fid}: {len(lane['f'])} finish events (want 1)")
+        if len(lane["names"]) != 1:
+            problems.append(f"flow {fid}: inconsistent names {sorted(lane['names'])}")
+        if lane["s"] and lane["f"]:
+            s_ts = lane["s"][0]["ts"]
+            f_ts = lane["f"][0]["ts"]
+            if f_ts < s_ts:
+                problems.append(f"flow {fid}: finish precedes start")
+            for t in lane["t"]:
+                if not (s_ts <= t["ts"] <= f_ts):
+                    problems.append(
+                        f"flow {fid}: step at ts={t['ts']} outside [start, finish]"
+                    )
+    return problems
+
+
+def build_lanes(events) -> Dict[int, Dict[str, Any]]:
+    """Reconstruct lanes: per flow id, the ordered points with their bound
+    slices, the lane window, and whether it completed."""
+    by_thread = _slices_by_thread(events)
+    lanes: Dict[int, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("ph") not in ("s", "t", "f"):
+            continue
+        lane = lanes.setdefault(
+            e["id"], {"id": e["id"], "kind": e["name"], "points": [], "meta": None}
+        )
+        sl = bound_slice(by_thread, e)
+        lane["points"].append({"phase": e["ph"], "ts": e["ts"], "slice": sl,
+                               "args": e.get("args")})
+        if e["ph"] == "s" and e.get("args"):
+            lane["meta"] = e["args"]
+    for lane in lanes.values():
+        lane["points"].sort(key=lambda p: p["ts"])
+        starts = [p["ts"] for p in lane["points"] if p["phase"] == "s"]
+        ends = [p["ts"] for p in lane["points"] if p["phase"] == "f"]
+        lane["start_us"] = starts[0] if starts else lane["points"][0]["ts"]
+        lane["end_us"] = ends[-1] if ends else lane["points"][-1]["ts"]
+        lane["complete"] = bool(starts and ends)
+        lane["total_us"] = max(0.0, lane["end_us"] - lane["start_us"])
+    return lanes
+
+
+def lane_breakdown(lane) -> Dict[str, float]:
+    """Non-overlapping per-phase µs for one lane.
+
+    Bound slices dedup by identity, then each attributes its SELF time —
+    its duration minus its directly-nested bound slices — so a container
+    (queue.flush enclosing the ingest spans, ingest.launch_attempt
+    enclosing the record readback) and its children decompose instead of
+    double-counting.  Durations clip to the lane window, and the
+    unattributed remainder reports as ``wait`` (queue residency,
+    scheduling, backoff sleeps)."""
+    seen: Dict[int, Dict[str, Any]] = {}
+    for p in lane["points"]:
+        if p["slice"] is not None:
+            seen[id(p["slice"])] = p["slice"]
+    slices = list(seen.values())
+
+    def clip(lo: float, hi: float) -> float:
+        return max(
+            0.0, min(hi, lane["end_us"]) - max(lo, lane["start_us"])
+        )
+
+    def contains(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+        return (
+            a is not b
+            and a["tid"] == b["tid"]
+            and a["ts"] <= b["ts"]
+            and b["ts"] + b["dur"] <= a["ts"] + a["dur"]
+        )
+
+    out: Dict[str, float] = defaultdict(float)
+    attributed = 0.0
+    for s in slices:
+        children = [c for c in slices if contains(s, c)]
+        direct = [
+            c
+            for c in children
+            if not any(contains(mid, c) for mid in children if mid is not c)
+        ]
+        self_dur = clip(s["ts"], s["ts"] + s["dur"]) - sum(
+            clip(c["ts"], c["ts"] + c["dur"]) for c in direct
+        )
+        self_dur = max(0.0, self_dur)
+        out[phase_of(s["name"])] += self_dur
+        attributed += self_dur
+    out["wait"] = max(0.0, lane["total_us"] - attributed)
+    return dict(out)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[i]
+
+
+def analyze(events, top: int = 5) -> Dict[str, Any]:
+    lanes = build_lanes(events)
+    complete = [l for l in lanes.values() if l["complete"]]
+    phase_totals: Dict[str, float] = defaultdict(float)
+    retried = degraded = 0
+    per_lane = []
+    for lane in complete:
+        bd = lane_breakdown(lane)
+        for k, v in bd.items():
+            phase_totals[k] += v
+        slice_names = [p["slice"]["name"] for p in lane["points"] if p["slice"]]
+        attempts = [
+            (p["slice"].get("args") or {}).get("attempt", 0)
+            for p in lane["points"]
+            if p["slice"] is not None
+            and p["slice"]["name"] == "ingest.launch_attempt"
+        ]
+        lane_retried = bool(attempts and max(attempts) > 0)
+        lane_degraded = any(n == "ingest.degrade" for n in slice_names)
+        retried += lane_retried
+        degraded += lane_degraded
+        per_lane.append(
+            {
+                "id": lane["id"],
+                "kind": lane["kind"],
+                "meta": lane["meta"],
+                "total_us": lane["total_us"],
+                "breakdown_us": bd,
+                "retried": lane_retried,
+                "degraded": lane_degraded,
+            }
+        )
+    per_lane.sort(key=lambda l: -l["total_us"])
+    totals = sorted(
+        ((k, v) for k, v in phase_totals.items()), key=lambda kv: -kv[1]
+    )
+    durs = sorted(l["total_us"] for l in complete)
+    return {
+        "lanes": len(lanes),
+        "complete": len(complete),
+        "incomplete": len(lanes) - len(complete),
+        "problems": validate_flows(events),
+        "phase_totals_us": dict(totals),
+        "p50_us": _quantile(durs, 0.50),
+        "p95_us": _quantile(durs, 0.95),
+        "p99_us": _quantile(durs, 0.99),
+        "max_us": durs[-1] if durs else 0.0,
+        "retried_lanes": retried,
+        "degraded_lanes": degraded,
+        "slowest": per_lane[:top],
+    }
+
+
+def format_report(a: Dict[str, Any]) -> str:
+    lines = []
+    lines.append(
+        f"lanes: {a['lanes']} ({a['complete']} complete, "
+        f"{a['incomplete']} incomplete)"
+    )
+    if a["problems"]:
+        lines.append(f"schema problems: {len(a['problems'])}")
+        for p in a["problems"][:10]:
+            lines.append(f"  ! {p}")
+    lines.append(
+        f"lane latency: p50 {a['p50_us']:.0f}us  p95 {a['p95_us']:.0f}us  "
+        f"p99 {a['p99_us']:.0f}us  max {a['max_us']:.0f}us"
+    )
+    lines.append(
+        f"attribution: {a['retried_lanes']} lane(s) retried, "
+        f"{a['degraded_lanes']} degraded"
+    )
+    total = sum(a["phase_totals_us"].values()) or 1.0
+    lines.append("critical path (all complete lanes):")
+    for phase, us in a["phase_totals_us"].items():
+        lines.append(f"  {phase:<12} {us:>12.0f}us  {100 * us / total:5.1f}%")
+    if a["slowest"]:
+        lines.append(f"top {len(a['slowest'])} slowest lanes:")
+        for l in a["slowest"]:
+            bd = sorted(l["breakdown_us"].items(), key=lambda kv: -kv[1])
+            bd_s = ", ".join(f"{k}={v:.0f}us" for k, v in bd if v > 0)
+            flags = ("+retry" if l["retried"] else "") + (
+                "+degraded" if l["degraded"] else ""
+            )
+            meta = f" {l['meta']}" if l["meta"] else ""
+            lines.append(
+                f"  #{l['id']} {l['kind']}{flags}: {l['total_us']:.0f}us"
+                f"  [{bd_s}]{meta}"
+            )
+    return "\n".join(lines)
+
+
+def summary_line(a: Dict[str, Any]) -> str:
+    """The one-line diffable summary (bench harnesses grep for the
+    ``trace_report:`` prefix)."""
+    total = sum(a["phase_totals_us"].values()) or 1.0
+    top_phase, top_us = (
+        next(iter(a["phase_totals_us"].items())) if a["phase_totals_us"] else ("none", 0.0)
+    )
+    return (
+        f"trace_report: lanes={a['lanes']} complete={a['complete']} "
+        f"problems={len(a['problems'])} p50_us={a['p50_us']:.0f} "
+        f"p95_us={a['p95_us']:.0f} p99_us={a['p99_us']:.0f} "
+        f"top_phase={top_phase}:{100 * top_us / total:.0f}% "
+        f"retried={a['retried_lanes']} degraded={a['degraded_lanes']}"
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("trace", help="PERITEXT_TRACE JSONL path")
+    parser.add_argument("--top", type=int, default=5, help="slowest lanes to show")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args()
+    events = load_events(args.trace)
+    a = analyze(events, top=args.top)
+    if args.json:
+        print(json.dumps(a))
+    else:
+        print(format_report(a))
+        print(summary_line(a))
+    return 1 if a["problems"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

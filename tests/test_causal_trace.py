@@ -1,0 +1,415 @@
+"""Causal-flow tracing + flight-recorder suite.
+
+What the acceptance criteria pin here:
+
+- a config-6-shape patched-fleet run with tracing on produces flow-event
+  lanes whose s/t/f triplets are well-formed (matching ids, every event
+  bound to a covering slice on its thread), the JSONL stays line-parseable
+  (Perfetto-loadable), and scripts/trace_report.py reconstructs a
+  critical-path breakdown + top-k slowest lanes from it;
+- under seeded chaos the flow graph stays acyclic and complete (no orphan
+  lanes), retries/degradation attribute to the right lanes, and the
+  degraded run's output stays byte-identical to a fault-free control;
+- the flight recorder is a bounded ring (overwrites counted as drops) and
+  black-box dumps fire on breaker trips, launch-budget exhaustion, and
+  checkpoint corruption — each dump parses, names its trigger, and its
+  ring events carry the failing batch's trace ids;
+- e2e latency histograms are fed at the terminal seams and summary()
+  reports percentile estimates for them;
+- PERITEXT_METRICS_INTERVAL leaves a recent atomic snapshot behind
+  without waiting for interpreter exit.
+"""
+import glob
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from peritext_tpu.oracle import Doc
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.ops.doc import TpuDoc
+from peritext_tpu.ops.universe import DeviceLaunchError
+from peritext_tpu.runtime import ChangeQueue, Publisher, faults, health, telemetry
+from peritext_tpu.runtime.checkpoint import CheckpointManager
+from peritext_tpu.runtime.faults import FaultPlan
+from peritext_tpu.runtime.health import HealthPlan
+
+_REPORT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts",
+    "trace_report.py",
+)
+_spec = importlib.util.spec_from_file_location("trace_report", _REPORT_PATH)
+trace_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_report)
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    """Pristine telemetry/fault/health planes around every test (the
+    ambient plane — e.g. a suite-wide PERITEXT_TRACE/PERITEXT_BLACKBOX run
+    — is detached and restored, not destroyed)."""
+    saved = (
+        telemetry.enabled,
+        telemetry._tracer,
+        telemetry._metrics_path,
+        telemetry._registry,
+        telemetry._recorder,
+        telemetry._blackbox_dir,
+    )
+    telemetry.enabled = False
+    telemetry._tracer = None
+    telemetry._metrics_path = None
+    telemetry._registry = telemetry.Registry()
+    telemetry._recorder = None
+    telemetry._blackbox_dir = None
+    faults.reset()
+    health.reset()
+    monkeypatch.delenv("PERITEXT_FAULTS", raising=False)
+    monkeypatch.delenv("PERITEXT_BREAKER", raising=False)
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    yield
+    telemetry.reset()
+    (
+        telemetry.enabled,
+        telemetry._tracer,
+        telemetry._metrics_path,
+        telemetry._registry,
+        telemetry._recorder,
+        telemetry._blackbox_dir,
+    ) = saved
+    faults.reset()
+    health.reset()
+
+
+def _author_changes(n_edits=4):
+    alice = Doc("alice")
+    genesis, _ = alice.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list("config six steady state")},
+        ]
+    )
+    edits = []
+    for i in range(n_edits):
+        ops = [{"path": ["text"], "action": "insert", "index": i, "values": ["x"]}]
+        if i % 2:
+            ops.append(
+                {"path": ["text"], "action": "addMark", "startIndex": 0,
+                 "endIndex": 5 + i, "markType": "strong"}
+            )
+        c, _ = alice.change(ops)
+        edits.append(c)
+    return genesis, edits
+
+
+def _queue_fleet(genesis, edits, num_replicas=4, name="flow-fleet"):
+    """Patched-fleet ingest driven through a ChangeQueue — the config-6
+    steady-state shape at test size.  Returns (universe, patch streams)."""
+    names = [f"r{i}" for i in range(num_replicas)]
+    uni = TpuUniverse(names)
+    streams = []
+
+    def handler(chs):
+        for c in chs:
+            streams.append(uni.apply_changes_with_patches({n: [c] for n in names}))
+
+    q = ChangeQueue(handler, name=name)
+    q.enqueue(genesis)
+    q.flush()
+    for c in edits:
+        q.enqueue(c)
+        q.flush()
+    return uni, streams
+
+
+def _events(path):
+    telemetry.flush_trace()
+    return trace_report.load_events(path)
+
+
+# ---------------------------------------------------------------------------
+# Flow-event schema: well-formed triplets, bound events, complete lanes
+# ---------------------------------------------------------------------------
+
+
+def test_flow_schema_on_patched_fleet(tmp_path):
+    trace = str(tmp_path / "fleet.jsonl")
+    telemetry.enable(trace=trace)
+    genesis, edits = _author_changes()
+    _queue_fleet(genesis, edits)
+    events = _events(trace)
+    # Perfetto-loadable: every line parsed (load_events would have thrown),
+    # and the flow graph is well-formed.
+    assert trace_report.validate_flows(events) == []
+    lanes = trace_report.build_lanes(events)
+    assert len(lanes) == 1 + len(edits)  # one lane per enqueued change
+    assert all(l["complete"] for l in lanes.values())
+    # Each lane stepped through the ingest seams: device launch, readback,
+    # assembly all attribute on the critical path.
+    a = trace_report.analyze(events)
+    for phase in ("device", "readback", "assembly"):
+        assert a["phase_totals_us"].get(phase, 0) > 0, a["phase_totals_us"]
+    assert a["slowest"], "top-k slowest lanes missing"
+    assert a["problems"] == []
+    line = trace_report.summary_line(a)
+    assert line.startswith("trace_report: lanes=") and "top_phase=" in line
+    report = trace_report.format_report(a)
+    assert "critical path" in report and "slowest lanes" in report
+    # The terminal seam fed the e2e histogram once per lane.
+    hists = telemetry.snapshot()["histograms"]
+    assert hists["e2e.enqueue_to_applied"]["count"] == len(lanes)
+    # And summary() surfaces percentile estimates for it.
+    s = telemetry.summary()
+    assert "e2e" in s and "enqueue_to_applied" in s["e2e"]
+    assert set(s["e2e"]["enqueue_to_applied"]) >= {"p50", "p95", "p99"}
+
+
+def test_flow_graph_acyclic_complete_under_seeded_chaos(tmp_path, monkeypatch):
+    """Seeded launch-failure chaos: lanes survive retries, stay complete
+    and timestamp-ordered (acyclic), and retry attribution lands on the
+    lanes whose batches actually retried."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "3")
+    trace = str(tmp_path / "chaos.jsonl")
+    telemetry.enable(trace=trace)
+    genesis, edits = _author_changes()
+    plan = FaultPlan(seed=11).with_site("device_launch", fail=2)
+    with faults.injected(plan):
+        _queue_fleet(genesis, edits, name="chaos-fleet")
+    events = _events(trace)
+    assert trace_report.validate_flows(events) == []
+    a = trace_report.analyze(events)
+    assert a["incomplete"] == 0, "orphan lanes under chaos"
+    assert a["retried_lanes"] >= 1, "retries did not attribute to any lane"
+    counters = telemetry.snapshot()["counters"]
+    assert counters["ingest.launch_failures"] == 2
+
+
+def test_pubsub_publish_to_deliver_lane(tmp_path):
+    trace = str(tmp_path / "pubsub.jsonl")
+    telemetry.enable(trace=trace)
+    pub = Publisher()
+    got = []
+    pub.subscribe("a", lambda u: got.append(("a", u)))
+    pub.subscribe("b", lambda u: got.append(("b", u)))
+    for i in range(3):
+        pub.publish("z", i)
+    assert len(got) == 6
+    events = _events(trace)
+    assert trace_report.validate_flows(events) == []
+    lanes = trace_report.build_lanes(events)
+    assert len(lanes) == 3  # one lane per publish
+    for lane in lanes.values():
+        assert lane["kind"] == "pubsub.publish"
+        # s + one step per delivered subscriber + f
+        phases = [p["phase"] for p in lane["points"]]
+        assert phases[0] == "s" and phases[-1] == "f"
+        assert phases.count("t") == 2
+    hists = telemetry.snapshot()["histograms"]
+    assert hists["e2e.publish_to_delivered"]["count"] == 6
+    # A raising subscriber still terminates the lane (no orphan flows).
+    pub2 = Publisher()
+    pub2.subscribe("ok", lambda u: None)
+    pub2.subscribe("boom", lambda u: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(RuntimeError):
+        pub2.publish("z", 99)
+    events = _events(trace)
+    assert trace_report.validate_flows(events) == []
+
+
+def test_tpudoc_change_lane_success_and_rollback(tmp_path):
+    trace = str(tmp_path / "doc.jsonl")
+    telemetry.enable(trace=trace)
+    doc = TpuDoc("author")
+    doc.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list("lane")},
+        ]
+    )
+    # Rollback lane: exhaust the launch budget mid-change.
+    with faults.injected(FaultPlan(seed=5).with_site("device_launch", fail=10)):
+        with pytest.raises(DeviceLaunchError):
+            doc.change(
+                [{"path": ["text"], "action": "insert", "index": 1, "values": ["z"]}]
+            )
+    events = _events(trace)
+    assert trace_report.validate_flows(events) == []
+    lanes = trace_report.build_lanes(events)
+    kinds = sorted(l["kind"] for l in lanes.values())
+    assert kinds == ["doc.change", "doc.change"]
+    assert all(l["complete"] for l in lanes.values())
+    # The recorder logged both fates, with the lanes' trace ids attached.
+    ring = telemetry.recorder_events()
+    doc_events = [e for e in ring if e["site"] == "doc.change"]
+    assert [e["outcome"] for e in doc_events] == ["applied", "rollback"]
+    assert all("flow" in e for e in doc_events)
+    hists = telemetry.snapshot()["histograms"]
+    assert hists["e2e.change_to_applied"]["count"] == 1  # only the success
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder + black-box dumps
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded_and_counts_drops(monkeypatch):
+    monkeypatch.setenv("PERITEXT_BLACKBOX_RING", "8")
+    telemetry.enable()
+    for i in range(20):
+        telemetry.record("site.x", outcome="ok", i=i)
+    n, dropped = telemetry.recorder_stats()
+    assert (n, dropped) == (20, 12)
+    ring = telemetry.recorder_events()
+    assert len(ring) == 8
+    # Oldest-first, holding exactly the last 8 events.
+    assert [e["fields"]["i"] for e in ring] == list(range(12, 20))
+    s = telemetry.summary()
+    assert s["recorder_events"] == 20 and s["recorder_dropped"] == 12
+
+
+def test_recorder_disabled_records_nothing():
+    assert not telemetry.enabled
+    telemetry.record("site.x", outcome="ok")
+    assert telemetry.recorder_stats() == (0, 0)
+    assert telemetry.recorder_events() == []
+
+
+def test_blackbox_dump_on_breaker_trip_and_exhaustion(tmp_path, monkeypatch):
+    """The wedge-storm post-mortem: budget exhaustion and the breaker trip
+    each dump, the trip dump names the tripped site, and the ring's
+    failed-launch events carry the failing batch's trace ids."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    box = str(tmp_path / "box")
+    trace = str(tmp_path / "trip.jsonl")
+    telemetry.enable(trace=trace, blackbox=box)
+    genesis, edits = _author_changes(n_edits=2)
+    plan = health.install(HealthPlan(seed=7))
+    plan.site("device_launch", threshold=2, cooldown=60, jitter=0.0)
+    with faults.injected(FaultPlan(seed=7).with_site("device_launch", fail=99)):
+        uni, _ = _queue_fleet(genesis, edits, num_replicas=2, name="storm")
+    assert uni.stats["degraded_batches"] == len(edits) + 1
+    dumps = sorted(glob.glob(os.path.join(box, "blackbox-*.json")))
+    reasons = [os.path.basename(d).rsplit("-", 1)[1][:-5] for d in dumps]
+    assert "breaker_trip" in reasons and "launch_budget_exhausted" in reasons
+    trip = json.load(open(dumps[reasons.index("breaker_trip")]))
+    assert trip["reason"] == "breaker_trip"
+    assert trip["info"]["site"] == "device_launch"
+    assert trip["metrics"]["counters"]["ingest.launch_failures"] >= 2
+    fails = [e for e in trip["ring"] if e["site"] == "ingest.launch"
+             and e["outcome"] == "fail"]
+    assert fails, trip["ring"]
+    # The failing batch's causal lane is named in the ring (trace ids).
+    assert any("flow" in e for e in fails), fails
+    # Dump accounting landed in the registry + summary.
+    s = telemetry.summary()
+    assert s["blackbox_dumps"] == len(dumps)
+    # Degraded output still byte-identical: replay fault-free and compare.
+    health.reset()
+    control = TpuUniverse(["r0", "r1"])
+    for c in [genesis] + edits:
+        control.apply_changes_with_patches({"r0": [c], "r1": [c]})
+    assert uni.texts() == control.texts()
+    # The flow lanes survived the storm complete (degrade is a seam, not a
+    # lane-killer) and attribute as degraded.
+    events = _events(trace)
+    assert trace_report.validate_flows(events) == []
+    a = trace_report.analyze(events)
+    assert a["degraded_lanes"] >= len(edits)
+
+
+def test_blackbox_dump_on_checkpoint_corruption(tmp_path):
+    box = str(tmp_path / "box")
+    telemetry.enable(blackbox=box)
+    genesis, edits = _author_changes(n_edits=1)
+    uni = TpuUniverse(["r0"])
+    uni.apply_changes({"r0": [genesis]})
+    mgr = CheckpointManager(str(tmp_path / "snaps"), keep=3)
+    mgr.save(uni)
+    uni.apply_changes({"r0": edits})
+    with faults.injected(FaultPlan().with_site("checkpoint_write", corrupt=1)):
+        mgr.save(uni)  # torn write: newest generation truncated
+    restored = mgr.restore_latest()
+    assert restored is not None  # fell back to the intact generation
+    dumps = glob.glob(os.path.join(box, "blackbox-*-checkpoint_corrupt.json"))
+    assert len(dumps) == 1
+    dump = json.load(open(dumps[0]))
+    assert dump["reason"] == "checkpoint_corrupt"
+    assert "generation" in dump["info"]
+
+
+def test_blackbox_unarmed_is_noop(tmp_path):
+    telemetry.enable()
+    assert telemetry.blackbox_dir() is None
+    assert telemetry.blackbox_dump("anything", x=1) is None
+    assert "blackbox.dumps" not in telemetry.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# Percentile estimation + periodic metrics flush
+# ---------------------------------------------------------------------------
+
+
+def test_estimate_quantiles_from_log2_buckets():
+    telemetry.enable()
+    for v in [0.001] * 90 + [0.5] * 8 + [4.0] * 2:
+        telemetry.observe("e2e.test_metric", v)
+    h = telemetry.snapshot()["histograms"]["e2e.test_metric"]
+    q = telemetry.estimate_quantiles(h)
+    # Log2 buckets: estimates land within the right bucket (2x of truth).
+    assert 0.0005 <= q["p50"] <= 0.002
+    assert 0.25 <= q["p95"] <= 1.0
+    assert 2.0 <= q["p99"] <= 4.0
+    # Clamping: estimates never leave the observed range.
+    assert h["min"] <= q["p50"] <= q["p95"] <= q["p99"] <= h["max"]
+    assert telemetry.estimate_quantiles({"count": 0, "buckets": {}}) is None
+
+
+def test_metrics_interval_flushes_periodically(tmp_path):
+    path = str(tmp_path / "metrics.json")
+    telemetry.enable(metrics=path, metrics_interval=0.05)
+    telemetry.counter("interval.counter", 3)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not os.path.exists(path):
+        time.sleep(0.02)
+    assert os.path.exists(path), "periodic flush never wrote a snapshot"
+    # Atomic write: the file always parses, and a later flush refreshes it.
+    first = json.loads(open(path).read())
+    assert first["counters"]["interval.counter"] == 3
+    telemetry.counter("interval.counter", 4)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        snap = json.loads(open(path).read())
+        if snap["counters"].get("interval.counter") == 7:
+            break
+        time.sleep(0.02)
+    assert snap["counters"]["interval.counter"] == 7
+    # reset() stops the flusher (thread drains on its next wakeup).
+    flusher = telemetry._flusher
+    telemetry.reset()
+    assert flusher.stop_event.is_set()
+
+
+# ---------------------------------------------------------------------------
+# Disabled-path contract for the new sites
+# ---------------------------------------------------------------------------
+
+
+def test_new_sites_disabled_are_cheap_and_silent(tmp_path):
+    assert not telemetry.enabled
+    # flow() refuses to mint while disabled; every downstream helper
+    # no-ops on None/empty.
+    assert telemetry.flow("x") is None
+    telemetry.flow_point(None)
+    telemetry.flow_steps()
+    assert telemetry.current_flows() == ()
+    assert telemetry.current_flow() is None
+    # flowing() over no live contexts returns the shared null context.
+    assert telemetry.flowing(()) is telemetry.flowing((None,))
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert telemetry.recorder_stats() == (0, 0)

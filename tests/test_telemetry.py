@@ -54,11 +54,15 @@ def _clean_planes(monkeypatch):
         telemetry._tracer,
         telemetry._metrics_path,
         telemetry._registry,
+        telemetry._recorder,
+        telemetry._blackbox_dir,
     )
     telemetry.enabled = False
     telemetry._tracer = None
     telemetry._metrics_path = None
     telemetry._registry = telemetry.Registry()
+    telemetry._recorder = None
+    telemetry._blackbox_dir = None
     faults.reset()
     monkeypatch.delenv("PERITEXT_FAULTS", raising=False)
     monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
@@ -69,6 +73,8 @@ def _clean_planes(monkeypatch):
         telemetry._tracer,
         telemetry._metrics_path,
         telemetry._registry,
+        telemetry._recorder,
+        telemetry._blackbox_dir,
     ) = saved
     faults.reset()
 
@@ -79,14 +85,15 @@ def device_plane(uni):
 
 def assert_chrome_trace(path):
     """Schema-check every line as a Chrome trace event; returns the number
-    of complete ('X') events."""
+    of complete ('X') events.  Flow events ('s'/'t'/'f' — the causal-flow
+    plane) must carry a flow id and the flow category."""
     with open(path) as f:
         lines = f.read().splitlines()
     assert lines, "trace file is empty"
     n_complete = 0
     for line in lines:
         event = json.loads(line)  # every line is one standalone JSON object
-        assert event["ph"] in ("X", "M"), event
+        assert event["ph"] in ("X", "M", "s", "t", "f"), event
         assert isinstance(event["name"], str) and event["name"]
         assert isinstance(event["pid"], int)
         assert isinstance(event["tid"], int)
@@ -95,6 +102,10 @@ def assert_chrome_trace(path):
             assert isinstance(event["dur"], (int, float)) and event["dur"] >= 0
             assert event["cat"] == "peritext"
             n_complete += 1
+        elif event["ph"] in ("s", "t", "f"):
+            assert event["cat"] == "peritext.flow", event
+            assert isinstance(event["id"], int), event
+            assert isinstance(event["ts"], (int, float)) and event["ts"] >= 0
     return n_complete
 
 
@@ -228,6 +239,10 @@ def test_disabled_span_is_shared_and_allocation_free():
             t.counter("x")
         t.observe("y", 1.0)
         t.span("z")
+        t.record("r")
+        t.flow_point(None)
+        t.flow_steps()
+        t.flowing(())
     tracemalloc.start()
     base = tracemalloc.get_traced_memory()[0]
     for _ in range(1000):
@@ -236,6 +251,14 @@ def test_disabled_span_is_shared_and_allocation_free():
         t.observe("y", 1.0)
         t.gauge_max("g", 2.0)
         t.span("z")
+        # The causal-flow + flight-recorder sites share the contract:
+        # guarded mint, None-propagating points, null flowing context,
+        # recorder no-op — none may allocate while disabled.
+        ctx = t.flow("f") if t.enabled else None
+        t.flow_point(ctx)
+        t.flow_steps()
+        t.flowing(())
+        t.record("r")
     delta = tracemalloc.get_traced_memory()[0] - base
     tracemalloc.stop()
     assert delta < 16 * 1024, f"disabled telemetry path allocated {delta} bytes"
