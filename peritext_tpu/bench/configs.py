@@ -446,6 +446,43 @@ def config6_patched_fleet() -> Dict[str, Any]:
     }
 
 
+def config7_serving_plane() -> Dict[str, Any]:
+    """Serving-plane steady state: multi-session continuous batching vs
+    naive per-change ingest on identical traffic (runtime/serve.py).
+
+    The A/B legs share one authored traffic matrix (independent editors,
+    one replica per session) and assert byte-identical per-session patch
+    streams; the record is the throughput ratio, the admit-to-applied
+    percentiles, and the compile-shape counts.  Env knobs:
+    CONFIG7_SESSIONS / CONFIG7_ROUNDS / CONFIG7_CHANGES; the plane's own
+    PERITEXT_SERVE_* knobs apply to the served leg.
+    """
+    from peritext_tpu.bench.workloads import time_serve_ab
+
+    r = time_serve_ab(
+        sessions=int(os.environ.get("CONFIG7_SESSIONS", "8")),
+        rounds=int(os.environ.get("CONFIG7_ROUNDS", "8")),
+        changes_per_round=int(os.environ.get("CONFIG7_CHANGES", "8")),
+    )
+    return {
+        "config": 7,
+        "workload": f"{r['sessions']}-session serving plane, {r['rounds']} "
+        f"rounds x {r['changes_per_round']} changes/session, "
+        f"{r['doc_len']}-char docs",
+        "served_ops_per_sec": round(r["served_ops_per_sec"], 1),
+        "naive_ops_per_sec": round(r["naive_ops_per_sec"], 1),
+        "served_vs_naive": round(r["served_vs_naive"], 2),
+        "served_launches": r["served_launches"],
+        "naive_launches": r["naive_launches"],
+        "served_p95_admit_to_applied_ms": round(
+            r["served_p95_admit_to_applied_s"] * 1000, 2
+        ),
+        "served_p95_within_window": r["served_p95_within_window"],
+        "served_compiled_shapes": r["served_compiled_shapes"],
+        "naive_compiled_shapes": r["naive_compiled_shapes"],
+    }
+
+
 CONFIGS = {
     1: config1_trace_replay,
     2: config2_fuzz_style,
@@ -453,6 +490,7 @@ CONFIGS = {
     4: config4_batched_marked,
     5: config5_multichip,
     6: config6_patched_fleet,
+    7: config7_serving_plane,
 }
 
 

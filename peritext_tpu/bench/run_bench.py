@@ -19,6 +19,12 @@ def _emit(result: dict) -> None:
     from peritext_tpu.runtime import health, telemetry
 
     summary = telemetry.summary()
+    # The serving-plane tallies get their own top-level stamp (admission/
+    # batching/shed behavior + compile-cache hit rate) so serve A/B runs
+    # can diff it without digging through the telemetry block.
+    serve_summary = summary.pop("serve", None) if summary else None
+    if serve_summary:
+        result["serve"] = serve_summary
     if summary:
         result["telemetry"] = summary
     # Health-plane summary (breaker states, trip/fastfail/canary tallies)

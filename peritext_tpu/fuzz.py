@@ -256,6 +256,7 @@ def fuzz(
     clear_caches_every: int = 0,
     chaos: Optional[str] = None,
     chaos_quiesce: int = 8,
+    serve: bool = False,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -287,6 +288,18 @@ def fuzz(
     additionally inject device-launch faults, driving the engine's
     retry/degradation machinery under the same differential asserts.
 
+    With ``serve``, the same change traffic also drives a **serving plane**
+    (runtime/serve.py) fronting a TpuUniverse with one session per fuzz
+    replica — session weights, priorities, the batch target and the
+    deadline are drawn from the run's rng, so every seed exercises a
+    different admission schedule.  Each session submits exactly what its
+    doc received (local generations + deliveries, post-chaos-filter), the
+    plane steps once per iteration (manual mode — deterministic), and at
+    every check point the serve replicas must match the docs span-for-span
+    while each session's accumulated patch stream must reconstruct its
+    replica (``accumulate_patches``) — the serving-plane byte-identity
+    claim under the same adversarial schedules as the engines.
+
     With ``nested``, a share of iterations drive the host structural plane
     (nested makeMap/makeList/set/del, second-list edits and marks) and every
     sync additionally asserts root-view and nested-list-span convergence.
@@ -311,6 +324,75 @@ def fuzz(
     log.record(initial_change)
     comment_history: List[str] = []
     syncs: List[Dict[str, Any]] = []
+
+    serve_plane = None
+    serve_sessions: Dict[str, Any] = {}
+    if serve:
+        from peritext_tpu.ops import TpuUniverse
+        from peritext_tpu.runtime.serve import ServePlane
+
+        serve_uni = TpuUniverse([d.actor_id for d in docs])
+        serve_plane = ServePlane(
+            serve_uni,
+            start=False,  # manual stepping keeps the fuzz deterministic
+            batch_target=2 ** rng.randrange(2, 6),
+            deadline_ms=float(rng.choice([1, 5, 25])),
+            quantum=rng.choice([2, 4, 8]),
+        )
+        for d in docs:
+            serve_sessions[d.actor_id] = serve_plane.session(
+                f"s-{d.actor_id}",
+                replica=d.actor_id,
+                weight=rng.choice([1, 2, 4]),
+                priority=rng.choice(["interactive", "bulk"]),
+                record_stream=True,
+            )
+        for d in docs:
+            serve_sessions[d.actor_id].submit([initial_change])
+        if serve_plane.drain() != 0:
+            raise RuntimeError("serving plane failed to drain the genesis change")
+
+    def serve_submit(actor_id: str, changes) -> None:
+        if serve_plane is not None and changes:
+            serve_sessions[actor_id].submit(list(changes))
+
+    def serve_check() -> None:
+        """Catch each serve replica up to ITS doc's clock (dedup-idempotent
+        redelivery from the durable log — under chaos the session's lane
+        may be missing dropped deliveries the doc will only see at
+        quiesce), drain, and assert byte-identity: serve spans == doc
+        spans per replica, and each session's accumulated patch stream
+        reconstructs its replica."""
+        if serve_plane is None:
+            return
+        for d in docs:
+            serve_submit(
+                d.actor_id,
+                log.missing_changes(dict(d.clock), serve_uni.clock(d.actor_id)),
+            )
+        leftover = serve_plane.drain()
+        if leftover:
+            fail(
+                f"serving plane left {leftover} submission(s) undeliverable",
+                {"serve_stats": dict(serve_plane.stats)},
+            )
+        serve_spans = serve_uni.spans_batch()
+        for i, d in enumerate(docs):
+            doc_spans = d.get_text_with_formatting(["text"])
+            if serve_spans[i] != doc_spans:
+                fail(
+                    f"serve/doc span divergence on {d.actor_id}",
+                    {"serveDoc": serve_spans[i], "batchDoc": doc_spans},
+                )
+            if check_patches:
+                accumulated = accumulate_patches(
+                    serve_sessions[d.actor_id].patch_log
+                )
+                if accumulated != serve_spans[i]:
+                    fail(
+                        f"serve patch/batch de-sync on {d.actor_id}",
+                        {"patchDoc": accumulated, "batchDoc": serve_spans[i]},
+                    )
 
     def fail(message: str, extra: Dict[str, Any]) -> None:
         state = {
@@ -365,6 +447,7 @@ def fuzz(
             )
         for i in range(1, len(docs)):
             check_pair(0, i)
+        serve_check()
 
     done = 0
     # True while chaotic syncs have happened since the last fault-free
@@ -418,6 +501,7 @@ def fuzz(
         change, patches = doc.change([op])
         log.record(change)
         all_patches[target].extend(patches)
+        serve_submit(doc.actor_id, [change])
 
         left = rng.randrange(len(docs))
         right = rng.randrange(len(docs))
@@ -442,6 +526,13 @@ def fuzz(
             )
             all_patches[right].extend(apply_changes(docs[right], to_right, allow_gaps=True))
             all_patches[left].extend(apply_changes(docs[left], to_left, allow_gaps=True))
+            # The serving plane sees exactly what the docs saw (the
+            # post-filter streams); causally-unready submissions defer in
+            # the session lanes until redelivery makes them ready.
+            serve_submit(docs[right].actor_id, to_right)
+            serve_submit(docs[left].actor_id, to_left)
+            if serve_plane is not None:
+                serve_plane.step()
             # Convergence is only claimable at quiesce points; other
             # iterations stay chaotic and unverified.
             chaos_unverified = True
@@ -450,12 +541,16 @@ def fuzz(
                 quiesce_and_check()
                 chaos_unverified = False
         else:
-            all_patches[right].extend(
-                apply_changes(docs[right], log.missing_changes(docs[left].clock, docs[right].clock))
-            )
-            all_patches[left].extend(
-                apply_changes(docs[left], log.missing_changes(docs[right].clock, docs[left].clock))
-            )
+            to_right = log.missing_changes(docs[left].clock, docs[right].clock)
+            to_left = log.missing_changes(docs[right].clock, docs[left].clock)
+            all_patches[right].extend(apply_changes(docs[right], to_right))
+            all_patches[left].extend(apply_changes(docs[left], to_left))
+            serve_submit(docs[right].actor_id, to_right)
+            serve_submit(docs[left].actor_id, to_left)
+            if serve_plane is not None:
+                serve_plane.step()
+                if done % chaos_quiesce == 0:
+                    serve_check()
             check_pair(left, right)
             verified = True
         # Progress AFTER the iteration's checks: a soak line only claims
@@ -480,6 +575,9 @@ def fuzz(
         # iterations (or with deliveries still in the holdback buffers) —
         # a success return means every replica converged at the end.
         quiesce_and_check()
+    elif chaos_plan is None:
+        # The serving plane must end drained and byte-identical too.
+        serve_check()
 
     return {
         "docs": docs,
@@ -487,6 +585,7 @@ def fuzz(
         "patches": all_patches,
         "iterations": done,
         "final_spans": docs[0].get_text_with_formatting(["text"]),
+        "serve_stats": dict(serve_plane.stats) if serve_plane is not None else None,
     }
 
 
@@ -505,6 +604,12 @@ def _main() -> None:
         "oracle/TpuDoc replicas — the strongest cross-engine differential)",
     )
     parser.add_argument("--nested", action="store_true", help="also fuzz nested objects")
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="also drive the serving plane (runtime/serve.py): one session "
+        "per replica with rng-drawn weights/priorities/deadlines, stepped "
+        "per iteration, byte-identity asserted at every check point",
+    )
     parser.add_argument(
         "--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC, default=None, metavar="SPEC",
         help="chaotic delivery between replicas (fault spec; bare flag uses "
@@ -561,8 +666,8 @@ def _main() -> None:
             factory: Callable[[str], Any] = TpuDoc
     else:
         factory = Doc
-    if args.chaos:
-        # Chaos runs are self-describing: the registry collects the
+    if args.chaos or args.serve:
+        # Chaos/serve runs are self-describing: the registry collects the
         # mirrored fault tallies (faults.<site>.<key>) plus the resilience
         # counters, and the run prints one summary line at the end —
         # PERITEXT_TRACE/PERITEXT_METRICS additionally activate the tracer
@@ -582,15 +687,16 @@ def _main() -> None:
             clear_caches_every=args.clear_caches_every,
             chaos=args.chaos,
             chaos_quiesce=args.chaos_quiesce,
+            serve=args.serve,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")
         err.save(path)
-        if args.chaos:
+        if args.chaos or args.serve:
             _print_telemetry_summary()
         print(f"FAILED: {err}; trace written to {path}")
         raise
-    if args.chaos:
+    if args.chaos or args.serve:
         _print_telemetry_summary()
     print(
         f"ok: {result['iterations']} iterations, final doc length "
@@ -612,7 +718,13 @@ def _print_telemetry_summary() -> None:
     rec_n, rec_dropped = telemetry.recorder_stats()
     summary.setdefault("recorder_events", rec_n)
     summary.setdefault("recorder_dropped", rec_dropped)
+    # The serving-plane tallies get their own diffable line (the admission/
+    # batching/shed behavior of a --serve run, incl. the admit-to-applied
+    # percentiles riding in the e2e block above).
+    serve_summary = summary.pop("serve", None)
     print("telemetry: " + json.dumps(summary, sort_keys=True), flush=True)
+    if serve_summary:
+        print("serve: " + json.dumps(serve_summary, sort_keys=True), flush=True)
     health_summary = health.summary()
     if health_summary:
         print("health: " + json.dumps(health_summary, sort_keys=True), flush=True)

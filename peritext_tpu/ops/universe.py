@@ -184,6 +184,16 @@ def _copy_jsonlike(x: Any) -> Any:
     return x
 
 
+def _strip_pos(pairs: List[Any], with_positions: bool) -> List[Any]:
+    """Finalize one replica's ``(pos, patch)`` stream: keep the pairs when
+    the caller asked for positions (the serving plane's per-submission
+    split), else strip to the plain patch list.  ``pairs`` must already be
+    in stream (pos) order — every producer sorts or emits in order."""
+    if with_positions:
+        return list(pairs)
+    return [p for _, p in pairs]
+
+
 # Transient-failure classification (shared with the Editor's delivery
 # buffer; see faults.retryable): transient errors retry, semantic errors
 # propagate untouched.
@@ -1627,8 +1637,10 @@ class TpuUniverse:
 
     @_blackbox_on_error
     def apply_changes_with_patches(
-        self, per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]]
-    ) -> Dict[str, List[Dict[str, Any]]]:
+        self,
+        per_replica: Dict[str, Sequence[Change]] | List[Sequence[Change]],
+        with_positions: bool = False,
+    ) -> Dict[str, List[Any]]:
         """Causally-gated ingestion that also emits the reference Patch
         stream per replica (micromerge.ts:25-30).
 
@@ -1648,6 +1660,14 @@ class TpuUniverse:
         planes (the A/B baseline).  Both formats assemble byte-identical
         streams; a compact launch whose span counts overflow the adaptive
         cap re-reads that batch via planes.
+
+        With ``with_positions`` each replica's list holds ``(pos, patch)``
+        pairs instead of bare patches, where ``pos`` is the patch's op's
+        flat index in that replica's gated (ordered, deduplicated) batch
+        stream — the serving plane (runtime/serve.py) uses the ranges to
+        split one continuous-batched launch's stream back into exact
+        per-submission patch lists.  The pair list is the same stream in
+        the same order; stripping positions yields the default return.
         """
         batches = self._normalize_batches(per_replica)
         prep = self._prepare(batches)
@@ -1675,7 +1695,9 @@ class TpuUniverse:
         if max_rows == 0:
             self._commit(prep)
             return {
-                name: [p for _, p in sorted(host_patches_for(r), key=lambda t: t[0])]
+                name: _strip_pos(
+                    sorted(host_patches_for(r), key=lambda t: t[0]), with_positions
+                )
                 for r, name in enumerate(self.replica_ids)
             }
 
@@ -1727,10 +1749,15 @@ class TpuUniverse:
                 mark_pos_list,
                 group_sizes,
                 multi_need,
+                with_positions=with_positions,
             )
-        return self._patched_scan(prep, host_patches_for, group_sizes, max_rows)
+        return self._patched_scan(
+            prep, host_patches_for, group_sizes, max_rows, with_positions=with_positions
+        )
 
-    def _patched_scan(self, prep, host_patches_for, group_sizes, max_rows):
+    def _patched_scan(
+        self, prep, host_patches_for, group_sizes, max_rows, with_positions=False
+    ):
         """The faithful interleaved per-op patch path (one scan step per
         op; the reference's asymptotics, kept as the deep-batch fallback
         and the PERITEXT_PATCH_PATH=scan differential leg)."""
@@ -1814,7 +1841,7 @@ class TpuUniverse:
                 raise
             pairs = self._degrade_apply(prep)
             return {
-                name: [p for _, p in pairs[r]]
+                name: _strip_pos(pairs[r], with_positions)
                 for r, name in enumerate(self.replica_ids)
             }
         self.states = new_states
@@ -1845,7 +1872,7 @@ class TpuUniverse:
                     rec, r % chunk, ops[r], tables[r], self.attrs, row_pos=g["row_pos"]
                 )
                 merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
-                out[name] = [p for _, p in merged]
+                out[name] = _strip_pos(merged, with_positions)
         return out
 
     def _patched_sorted(
@@ -1857,6 +1884,7 @@ class TpuUniverse:
         mark_pos_list,
         sizes,
         multi_need: int = 0,
+        with_positions: bool = False,
     ):
         """The patch-emitting sorted merge: placement rounds + mark-only
         scan + analytic text records (kernels.merge_step_sorted_patched).
@@ -2027,7 +2055,7 @@ class TpuUniverse:
                 raise  # committed state untouched: attempts never assign
             pairs = self._degrade_apply(prep)
             return {
-                name: [p for _, p in pairs[r]]
+                name: _strip_pos(pairs[r], with_positions)
                 for r, name in enumerate(self.replica_ids)
             }
         self.states = new_states
@@ -2084,7 +2112,7 @@ class TpuUniverse:
                     self.attrs,
                 )
                 merged = sorted(dev + host_patches_for(r), key=lambda t: t[0])
-                out[name] = [p for _, p in merged]
+                out[name] = _strip_pos(merged, with_positions)
         return out
 
     # -- materialization ----------------------------------------------------

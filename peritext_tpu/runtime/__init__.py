@@ -10,6 +10,13 @@ from peritext_tpu.runtime.health import BreakerOpenError, CircuitBreaker, Health
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.pubsub import Publisher
 from peritext_tpu.runtime.queue import ChangeQueue, QueueFullError
+from peritext_tpu.runtime.serve import (
+    ServeClosedError,
+    ServePlane,
+    ServeSession,
+    ServeShedError,
+    Submission,
+)
 from peritext_tpu.runtime.sync import (
     ConvergenceError,
     apply_available,
@@ -30,6 +37,11 @@ __all__ = [
     "HealthPlan",
     "Publisher",
     "QueueFullError",
+    "ServeClosedError",
+    "ServePlane",
+    "ServeSession",
+    "ServeShedError",
+    "Submission",
     "apply_available",
     "apply_changes",
     "causal_order",

@@ -21,6 +21,9 @@ site                      fired from
 ``queue_flush``           outbound batch flush (runtime/queue.py)
 ``checkpoint_write``      snapshot save (runtime/checkpoint.py)
 ``log_append``            durable change-log append (runtime/log.py)
+``serve_admit``           serving-plane session admission (runtime/serve.py;
+                          ``fail``/``wedge`` hit the submit call,
+                          drop/dup/reorder filter the submitted changes)
 ========================  ====================================================
 
 Schedules per site (all deterministic given the plan seed and call order):
@@ -63,6 +66,7 @@ KNOWN_SITES = (
     "queue_flush",
     "checkpoint_write",
     "log_append",
+    "serve_admit",
 )
 
 _STAT_KEYS = ("fired", "failed", "wedged", "dropped", "duplicated", "reordered", "corrupted")

@@ -54,7 +54,13 @@ from peritext_tpu.runtime import telemetry
 _log = logging.getLogger(__name__)
 _queue_ids = itertools.count()
 
-_POLICIES = ("block", "coalesce", "shed")
+# THE backpressure policy vocabulary, shared by every admission-control
+# surface in the runtime: ChangeQueue bounds (this module) and the serving
+# plane's per-session lanes (runtime/serve.py) accept exactly these names,
+# with the same semantics — block waits, coalesce merges losslessly at the
+# bound, shed drops oldest with telemetry.
+POLICIES = ("block", "coalesce", "shed")
+_POLICIES = POLICIES
 
 
 class QueueFullError(RuntimeError):

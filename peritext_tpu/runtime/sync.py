@@ -9,8 +9,9 @@ Lamport key + deps check before kernel launch" design (SURVEY.md §2.4).
 """
 from __future__ import annotations
 
+import heapq
 from collections import deque
-from typing import Any, Dict, List, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from peritext_tpu.runtime import telemetry
 
@@ -114,6 +115,96 @@ def apply_changes(
     return patches
 
 
+def _is_ready(change: Change, clock: Dict[str, int]) -> bool:
+    return clock.get(change["actor"], 0) == change["seq"] - 1 and all(
+        clock.get(actor, 0) >= dep
+        for actor, dep in (change.get("deps") or {}).items()
+    )
+
+
+def _blocker(change: Change, clock: Dict[str, int]) -> Optional[Tuple[str, int]]:
+    """The first unmet readiness condition as a ``(actor, value)`` wake key
+    (the change becomes re-checkable when ``clock[actor]`` reaches exactly
+    ``value``), or None when the change is ready now.  The clock only ever
+    advances in +1 steps per actor during an ordering walk, so it passes
+    through every integer it will ever exceed — a key whose value the clock
+    is already past can never fire, which is exactly the permanently-stuck
+    (duplicate/forked seq) case the callers report as unsatisfiable."""
+    if clock.get(change["actor"], 0) != change["seq"] - 1:
+        return (change["actor"], change["seq"] - 1)
+    for actor, dep in (change.get("deps") or {}).items():
+        if clock.get(actor, 0) < dep:
+            return (actor, dep)
+    return None
+
+
+def _retry_queue_order(
+    items: Sequence[Change], clock: Dict[str, int]
+) -> Tuple[List[Change], int]:
+    """The retry-queue emission order over ``items`` (positions = list
+    order), computed with an indexed ready-set instead of repeated passes.
+
+    Semantics are byte-identical to the reference retry loop (test/merge.ts:
+    4-23): scan the remaining changes in order, emitting the ones ready at
+    scan time; deferred changes keep their relative order and are rescanned
+    on the next pass.  Equivalently: within a pass, a change woken by an
+    emission at an *earlier* position still emits this pass; one woken by an
+    emission at a *later* position waits for the next pass.  The rotating
+    deque pays a full O(n) rescan per emission in the worst case (a reversed
+    single-actor chain is O(n^2)); here each change parks on the one unmet
+    ``(actor, value)`` condition blocking it and is re-examined only when
+    that clock entry lands — O(n + e) parks with an O(log n) heap pop per
+    emission.  ``clock`` is mutated in place.  Returns (ordered, leftover
+    count of unsatisfiable changes).
+    """
+    ready: List[int] = []  # current pass, heap by position
+    next_ready: List[int] = []  # woken at/before the cursor: next pass
+    waiting: Dict[Tuple[str, int], List[int]] = {}
+
+    def park(i: int) -> bool:
+        key = _blocker(items[i], clock)
+        if key is None:
+            return False
+        waiting.setdefault(key, []).append(i)
+        return True
+
+    for i in range(len(items)):
+        if not park(i):
+            ready.append(i)
+    heapq.heapify(ready)
+    ordered: List[Change] = []
+    parked = len(items) - len(ready)
+    while ready or next_ready:
+        if not ready:
+            ready = next_ready
+            next_ready = []
+            heapq.heapify(ready)
+        pos = heapq.heappop(ready)
+        change = items[pos]
+        # Re-check at pop time: a same-(actor, seq) duplicate classified
+        # ready earlier is stale once its twin emits (the rotating loop
+        # would defer it forever; here it re-parks on an unreachable key).
+        if not _is_ready(change, clock):
+            parked += park(pos)
+            continue
+        clock[change["actor"]] = change["seq"]
+        ordered.append(change)
+        woken = waiting.pop((change["actor"], change["seq"]), None)
+        if woken:
+            parked -= len(woken)
+            for w in woken:
+                if _is_ready(items[w], clock):
+                    # Later position: still scannable this pass.  Earlier:
+                    # already deferred this pass, emits next pass.
+                    if w > pos:
+                        heapq.heappush(ready, w)
+                    else:
+                        next_ready.append(w)
+                else:
+                    parked += park(w)
+    return ordered, parked
+
+
 def causal_order(changes: Sequence[Change], clock: Dict[str, int] | None = None) -> List[Change]:
     """Delivery-order-preserving causal ordering.
 
@@ -123,29 +214,17 @@ def causal_order(changes: Sequence[Change], clock: Dict[str, int] | None = None)
     beyond correctness: *patch streams are delivery-order-sensitive* (patch
     indices depend on what applied before), so batched engines must use this
     order — not an arbitrary topological sort — to emit the same patches an
-    incremental replica would.
+    incremental replica would.  O(n + e) via the indexed ready-set walk
+    (:func:`_retry_queue_order`); output order is byte-identical to the
+    rotating-deque formulation (tests/test_sync_order.py pins it against a
+    reference copy of the old loop).
     """
     clock = dict(clock or {})
-    pending = deque(changes)
-    ordered: List[Change] = []
-    stuck = 0
-    while pending:
-        change = pending.popleft()
-        ready = clock.get(change["actor"], 0) == change["seq"] - 1 and all(
-            clock.get(actor, 0) >= dep
-            for actor, dep in (change.get("deps") or {}).items()
+    ordered, leftover = _retry_queue_order(list(changes), clock)
+    if leftover:
+        raise ValueError(
+            f"causal_order: {leftover} changes have unsatisfiable dependencies"
         )
-        if ready:
-            clock[change["actor"]] = change["seq"]
-            ordered.append(change)
-            stuck = 0
-        else:
-            pending.append(change)
-            stuck += 1
-            if stuck > len(pending):
-                raise ValueError(
-                    f"causal_order: {len(pending)} changes have unsatisfiable dependencies"
-                )
     return ordered
 
 
@@ -156,29 +235,17 @@ def causal_sort(changes: Sequence[Change], clock: Dict[str, int] | None = None) 
     receiving replica's current ``clock``.  Ties broken by (startOp, actor)
     for determinism.  Raises ``ValueError`` if the batch has unsatisfiable
     dependencies — the batched-engine analog of the reference's
-    causal-readiness throw (micromerge.ts:501-509).
+    causal-readiness throw (micromerge.ts:501-509).  The frontier walk is
+    the shared :func:`_retry_queue_order` over the sorted positions, so the
+    emission order is byte-identical to the repeated-pass formulation at
+    O(n + e) instead of O(n * passes).
     """
     clock = dict(clock or {})
-    remaining = sorted(changes, key=lambda c: (c["startOp"], c["actor"], c["seq"]))
-    ordered: List[Change] = []
-    progress = True
-    while remaining and progress:
-        progress = False
-        deferred: List[Change] = []
-        for change in remaining:
-            ready = clock.get(change["actor"], 0) == change["seq"] - 1 and all(
-                clock.get(actor, 0) >= dep for actor, dep in (change.get("deps") or {}).items()
-            )
-            if ready:
-                clock[change["actor"]] = change["seq"]
-                ordered.append(change)
-                progress = True
-            else:
-                deferred.append(change)
-        remaining = deferred
-    if remaining:
+    items = sorted(changes, key=lambda c: (c["startOp"], c["actor"], c["seq"]))
+    ordered, leftover = _retry_queue_order(items, clock)
+    if leftover:
         raise ValueError(
-            f"causal_sort: {len(remaining)} changes have unsatisfiable dependencies"
+            f"causal_sort: {leftover} changes have unsatisfiable dependencies"
         )
     return ordered
 

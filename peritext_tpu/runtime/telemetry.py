@@ -827,6 +827,19 @@ def summary() -> Dict[str, Any]:
     }
     if health_mirror:
         out["health"] = health_mirror
+    # Serving-plane tallies (runtime/serve.py): present whenever serve
+    # traffic happened, so bench JSON stamps and the fuzz --chaos footer
+    # carry admission/batching/shed behavior without a separate plumbing
+    # path.  The e2e.admit_to_applied percentiles ride in out["e2e"].
+    serve_mirror = {
+        name[len("serve.") :]: n
+        for name, n in counters.items()
+        if name.startswith("serve.")
+    }
+    if serve_mirror:
+        if "serve.depth_max" in gauges:
+            serve_mirror["depth_max"] = gauges["serve.depth_max"]
+        out["serve"] = serve_mirror
     # End-to-end latency percentiles (the causal-flow plane's terminal
     # seams) + the key per-seam latencies, estimated from the log2
     # histograms — the "why was p99 40x the median" numbers a one-line

@@ -43,7 +43,22 @@ PHASE_OF = (
     ("stream.launch", "launch"),
     ("stream.drain", "drain"),
     ("checkpoint.", "checkpoint"),
+    ("serve.admit", "serve_admit"),
+    ("serve.resolve", "serve_resolve"),
+    ("serve.", "serve"),
 )
+
+# Lane kind -> the e2e.* histogram its terminal seam feeds (telemetry.py).
+# Lets a trace alone reproduce obs.summary()'s e2e percentiles, so serve
+# A/B runs can diff admit-to-applied latency from JSONL artifacts without
+# a metrics snapshot.
+KIND_E2E = {
+    "queue.change": "enqueue_to_applied",
+    "doc.change": "change_to_applied",
+    "pubsub.publish": "publish_to_delivered",
+    "stream.cohort": "cohort_launch_to_drain",
+    "serve.submit": "admit_to_applied",
+}
 
 
 def phase_of(name: str) -> str:
@@ -123,6 +138,24 @@ def validate_flows(events) -> List[str]:
                     problems.append(
                         f"flow {fid}: step at ts={t['ts']} outside [start, finish]"
                     )
+        # Serving-plane seam schema: an applied serve.submit lane must have
+        # stepped through a serve.flush-bound slice before finishing; a
+        # lane that never reached a flush must say why on its finish
+        # (shed / rejected / coalesced / empty / closed / error).
+        if lane["names"] == {"serve.submit"} and lane["s"] and lane["f"]:
+            finish = lane["f"][0]
+            outcome = (finish.get("args") or {}).get("outcome")
+            flushed = any(
+                (bound_slice(by_thread, e) or {}).get("name", "").startswith(
+                    "serve."
+                )
+                for e in lane["t"] + lane["f"]
+            )
+            if outcome is None and not flushed:
+                problems.append(
+                    f"flow {fid}: serve.submit lane finished without a "
+                    "serve.* seam or an explanatory outcome"
+                )
     return problems
 
 
@@ -245,6 +278,23 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
         ((k, v) for k, v in phase_totals.items()), key=lambda kv: -kv[1]
     )
     durs = sorted(l["total_us"] for l in complete)
+    # Per-terminal-seam e2e quantiles (parity with obs.summary()["e2e"]):
+    # lane kinds map to the histogram their finish feeds, so trace-only
+    # artifacts carry the same p50/p95/p99 shape the registry stamps.
+    by_e2e: Dict[str, List[float]] = defaultdict(list)
+    for lane in complete:
+        name = KIND_E2E.get(lane["kind"])
+        if name is not None:
+            by_e2e[name].append(lane["total_us"])
+    e2e = {}
+    for name, vals in sorted(by_e2e.items()):
+        vals.sort()
+        e2e[name] = {
+            "count": len(vals),
+            "p50_us": _quantile(vals, 0.50),
+            "p95_us": _quantile(vals, 0.95),
+            "p99_us": _quantile(vals, 0.99),
+        }
     return {
         "lanes": len(lanes),
         "complete": len(complete),
@@ -255,6 +305,7 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
         "p95_us": _quantile(durs, 0.95),
         "p99_us": _quantile(durs, 0.99),
         "max_us": durs[-1] if durs else 0.0,
+        "e2e": e2e,
         "retried_lanes": retried,
         "degraded_lanes": degraded,
         "slowest": per_lane[:top],
@@ -279,6 +330,13 @@ def format_report(a: Dict[str, Any]) -> str:
         f"attribution: {a['retried_lanes']} lane(s) retried, "
         f"{a['degraded_lanes']} degraded"
     )
+    if a.get("e2e"):
+        lines.append("e2e (per terminal seam):")
+        for name, q in a["e2e"].items():
+            lines.append(
+                f"  {name:<24} n={q['count']:<6} p50 {q['p50_us']:.0f}us  "
+                f"p95 {q['p95_us']:.0f}us  p99 {q['p99_us']:.0f}us"
+            )
     total = sum(a["phase_totals_us"].values()) or 1.0
     lines.append("critical path (all complete lanes):")
     for phase, us in a["phase_totals_us"].items():

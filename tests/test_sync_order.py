@@ -1,0 +1,212 @@
+"""causal_order / causal_sort: byte-identical order vs the old repeated-pass
+loops, plus the O(n + e) perf regression pin (ISSUE 10 satellite).
+
+The rotating-deque / repeated-pass formulations are kept here as reference
+implementations; the shipped indexed-ready-set versions must emit the
+SAME sequence for any batch (the property matrix below drives random
+chains, cross-actor deps, duplicates, shuffles) and degrade gracefully to
+the same unsatisfiable-dependency error.  The perf test pins the
+complexity fix: a reversed 10k-change single-actor chain was O(n^2) in
+the old loop and must now run in linear-ish time.
+"""
+import random
+import time
+from collections import deque
+
+import pytest
+
+from peritext_tpu.runtime.sync import causal_order, causal_sort
+
+
+# -- reference implementations (the pre-ISSUE-10 loops, verbatim) ------------
+
+
+def _ready(change, clock):
+    return clock.get(change["actor"], 0) == change["seq"] - 1 and all(
+        clock.get(actor, 0) >= dep
+        for actor, dep in (change.get("deps") or {}).items()
+    )
+
+
+def ref_causal_order(changes, clock=None):
+    clock = dict(clock or {})
+    pending = deque(changes)
+    ordered = []
+    stuck = 0
+    while pending:
+        change = pending.popleft()
+        if _ready(change, clock):
+            clock[change["actor"]] = change["seq"]
+            ordered.append(change)
+            stuck = 0
+        else:
+            pending.append(change)
+            stuck += 1
+            if stuck > len(pending):
+                raise ValueError("unsatisfiable")
+    return ordered
+
+
+def ref_causal_sort(changes, clock=None):
+    clock = dict(clock or {})
+    remaining = sorted(changes, key=lambda c: (c["startOp"], c["actor"], c["seq"]))
+    ordered = []
+    progress = True
+    while remaining and progress:
+        progress = False
+        deferred = []
+        for change in remaining:
+            if _ready(change, clock):
+                clock[change["actor"]] = change["seq"]
+                ordered.append(change)
+                progress = True
+            else:
+                deferred.append(change)
+        remaining = deferred
+    if remaining:
+        raise ValueError("unsatisfiable")
+    return ordered
+
+
+# -- generators ---------------------------------------------------------------
+
+
+def chain(actor, n, start_op=1, deps=None):
+    return [
+        {
+            "actor": actor,
+            "seq": s,
+            "deps": dict(deps or {}),
+            "startOp": start_op + s - 1,
+            "ops": [],
+        }
+        for s in range(1, n + 1)
+    ]
+
+
+def random_batch(rng, n_actors=3, n=40, dep_p=0.5, dup_p=0.1):
+    """A causally-consistent multi-actor history, then shuffled delivery:
+    actors extend their chains, sometimes depending on the current global
+    frontier; a few changes are duplicated (the rotating loop defers dups
+    forever, so dup batches assert the unsatisfiable path instead)."""
+    frontier = {}
+    batch = []
+    op = 1
+    for _ in range(n):
+        actor = f"a{rng.randrange(n_actors)}"
+        seq = frontier.get(actor, 0) + 1
+        deps = {}
+        if rng.random() < dep_p:
+            deps = {
+                a: s for a, s in frontier.items() if a != actor and rng.random() < 0.7
+            }
+        batch.append(
+            {"actor": actor, "seq": seq, "deps": deps, "startOp": op, "ops": []}
+        )
+        frontier[actor] = seq
+        op += rng.randrange(1, 4)
+    dups = [dict(c) for c in batch if rng.random() < dup_p]
+    shuffled = batch + dups
+    rng.shuffle(shuffled)
+    return shuffled, bool(dups)
+
+
+def ids(changes):
+    return [(c["actor"], c["seq"]) for c in changes]
+
+
+# -- equivalence matrix -------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_matches_reference_on_random_batches(seed):
+    rng = random.Random(seed)
+    batch, has_dups = random_batch(rng)
+    for new, ref in ((causal_order, ref_causal_order), (causal_sort, ref_causal_sort)):
+        if has_dups:
+            # A duplicated (actor, seq) can never become ready; both
+            # formulations must report the batch unsatisfiable.
+            with pytest.raises(ValueError):
+                ref(batch)
+            with pytest.raises(ValueError):
+                new(batch)
+        else:
+            assert ids(new(batch)) == ids(ref(batch)), (new.__name__, seed)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_matches_reference_with_seed_clock(seed):
+    rng = random.Random(1000 + seed)
+    batch, has_dups = random_batch(rng, n_actors=2, n=25, dup_p=0.0)
+    assert not has_dups
+    # Seed the clock mid-chain: changes at/below the clock are permanently
+    # unready in BOTH formulations (callers dedupe first; the walk must
+    # agree on the failure too).
+    clock = {"a0": 1}
+    for new, ref in ((causal_order, ref_causal_order), (causal_sort, ref_causal_sort)):
+        try:
+            expected = ids(ref(batch, clock))
+            failed = False
+        except ValueError:
+            failed = True
+        if failed:
+            with pytest.raises(ValueError):
+                new(batch, clock)
+        else:
+            assert ids(new(batch, clock)) == expected
+
+
+def test_wake_at_earlier_position_waits_for_next_pass():
+    """The divergence-prone shape: emitting R wakes Q at an EARLIER
+    position while S (later, ready) is still unscanned this pass — the
+    retry loop emits R, S, Q, and so must we."""
+    q = {"actor": "q", "seq": 1, "deps": {"r": 1}, "startOp": 1, "ops": []}
+    r = {"actor": "r", "seq": 1, "deps": {}, "startOp": 2, "ops": []}
+    s = {"actor": "s", "seq": 1, "deps": {}, "startOp": 3, "ops": []}
+    batch = [q, r, s]
+    assert ids(causal_order(batch)) == ids(ref_causal_order(batch)) == [
+        ("r", 1), ("s", 1), ("q", 1),
+    ]
+
+
+def test_unsatisfiable_raises_with_count():
+    batch = chain("a", 3)[1:]  # seq 1 missing
+    with pytest.raises(ValueError, match="2 changes have unsatisfiable"):
+        causal_order(batch)
+    with pytest.raises(ValueError, match="2 changes have unsatisfiable"):
+        causal_sort(batch)
+
+
+# -- the perf regression pin --------------------------------------------------
+
+
+def test_reversed_10k_chain_is_not_quadratic():
+    """10k-change single-actor chain delivered in REVERSE: the old rotating
+    loop rescans the whole queue per emission (~5e7 readiness checks,
+    minutes of Python); the indexed ready-set does one park + one wake per
+    change.  Generous wall bound for the loaded 1-core box — the old code
+    exceeds it by two orders of magnitude."""
+    batch = list(reversed(chain("a", 10_000)))
+    t0 = time.perf_counter()
+    ordered = causal_order(batch)
+    elapsed = time.perf_counter() - t0
+    assert [c["seq"] for c in ordered] == list(range(1, 10_001))
+    assert elapsed < 5.0, f"causal_order took {elapsed:.1f}s on a 10k chain"
+
+
+def test_dep_chain_causal_sort_is_not_quadratic():
+    """Cross-actor dependency chain whose sort order is reversed (startOp
+    descending along the causal chain): one change becomes ready per old
+    pass — the quadratic shape for causal_sort."""
+    n = 4000
+    batch = []
+    for i in range(n):
+        deps = {f"a{i - 1}": 1} if i else {}
+        batch.append(
+            {"actor": f"a{i}", "seq": 1, "deps": deps, "startOp": n - i, "ops": []}
+        )
+    t0 = time.perf_counter()
+    ordered = causal_sort(batch)
+    elapsed = time.perf_counter() - t0
+    assert ids(ordered) == [(f"a{i}", 1) for i in range(n)]
+    assert elapsed < 5.0, f"causal_sort took {elapsed:.1f}s on a {n}-dep chain"

@@ -568,6 +568,39 @@ def test_steady_state_overhead_within_contract():
     assert overhead < 0.02 or absolute < 0.1, r
 
 
+def test_serve_sites_disabled_record_nothing():
+    """The serving plane's telemetry sites share the overhead contract:
+    with collection off, a full submit/flush/resolve cycle must leave the
+    registry empty (every site guards on the one `telemetry.enabled`
+    attribute) while the plane's local stats still count."""
+    from peritext_tpu.runtime.serve import ServePlane
+
+    assert not telemetry.enabled
+    changes = _author_stream()
+    uni = TpuUniverse(["r0"])
+    plane = ServePlane(uni, start=False, batch_target=8)
+    s = plane.session("s0", replica="r0", record_stream=True)
+    for change in changes:
+        s.submit([change])
+    assert plane.drain() == 0
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+    assert snap["histograms"] == {}
+    assert plane.stats["flushes"] >= 1
+    assert telemetry.summary() == {}
+
+
+def test_serve_summary_section_rides_summary():
+    telemetry.enable()
+    telemetry.counter("serve.flushes", 3)
+    telemetry.counter("serve.shed", 2)
+    telemetry.gauge_max("serve.depth_max", 9)
+    s = telemetry.summary()
+    assert s["serve"]["flushes"] == 3
+    assert s["serve"]["shed"] == 2
+    assert s["serve"]["depth_max"] == 9
+
+
 def test_degraded_ingest_counts_in_registry():
     telemetry.enable()
     changes = _author_stream()
