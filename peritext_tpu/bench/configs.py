@@ -19,9 +19,15 @@ bridge/oracle at human scale); configs 3-5 are the batched device workloads
    by default; ``dense`` pins the full-plane A/B baseline, ``scan`` the
    interleaved fallback), so the dense-vs-delta A/B is two invocations
    of the same config.
+7. serving-plane steady state: continuous batching vs naive per-change
+   ingest on identical traffic (runtime/serve.py).
+8. mesh-sharded serving: identical traffic through 1 vs K universe
+   shards (runtime/serve_shard.py), scaling curve + shape-bucket bound.
 
 Env knobs: CONFIG5_REPLICAS / CONFIG5_DOC_LEN override config 5's scale;
-CONFIG6_REPLICAS / CONFIG6_ROUNDS config 6's.
+CONFIG6_REPLICAS / CONFIG6_ROUNDS config 6's; CONFIG7_SESSIONS / ROUNDS /
+CHANGES config 7's; CONFIG8_SHARDS / SESSIONS / ROUNDS / CHANGES /
+DOC_LEN config 8's.
 """
 from __future__ import annotations
 
@@ -483,6 +489,56 @@ def config7_serving_plane() -> Dict[str, Any]:
     }
 
 
+def config8_sharded_serving() -> Dict[str, Any]:
+    """Mesh-sharded serving steady state: identical multi-session traffic
+    through 1 vs K universe shards (runtime/serve_shard.py).
+
+    The record is the served-throughput scaling curve (1-shard leg is the
+    PR-10 single-plane shape; per-shard cohort launches sweep 1/K of the
+    fleet rows for the same batch budget), per-session byte-identity
+    asserted in-harness, and the fleet-wide compiled-shape bound the pow2
+    shard buckets hold.  Env knobs: CONFIG8_SHARDS (comma list, default
+    "1,8"), CONFIG8_SESSIONS / CONFIG8_ROUNDS / CONFIG8_CHANGES /
+    CONFIG8_DOC_LEN; the planes' PERITEXT_SERVE_* knobs apply per shard.
+    """
+    from peritext_tpu.bench.workloads import time_serve_shard_ab
+
+    shard_counts = [
+        int(k) for k in os.environ.get("CONFIG8_SHARDS", "1,8").split(",")
+    ]
+    r = time_serve_shard_ab(
+        sessions=int(os.environ.get("CONFIG8_SESSIONS", "64")),
+        rounds=int(os.environ.get("CONFIG8_ROUNDS", "4")),
+        changes_per_round=int(os.environ.get("CONFIG8_CHANGES", "8")),
+        doc_len=int(os.environ.get("CONFIG8_DOC_LEN", "600")),
+        shard_counts=shard_counts,
+    )
+    legs = {
+        leg["shards"]: {
+            "ops_per_sec": round(leg["ops_per_sec"], 1),
+            # Relative to the FIRST configured leg (only a 1-shard
+            # baseline when CONFIG8_SHARDS starts with 1, the default).
+            "speedup_vs_first": round(leg["speedup_vs_first"], 2),
+            "launches": leg["launches"],
+            "fleet_compiled_shapes": leg["fleet_compiled_shapes"],
+            "p95_admit_to_applied_ms": round(
+                leg["p95_admit_to_applied_s"] * 1000, 2
+            ),
+        }
+        for leg in r["legs"]
+    }
+    return {
+        "config": 8,
+        "workload": f"{r['sessions']}-session sharded serving, "
+        f"{r['rounds']} rounds x {r['changes_per_round']} changes/session, "
+        f"{r['doc_len']}-char docs, shards {shard_counts}",
+        "baseline_shards": shard_counts[0],
+        "byte_identity": r["byte_identity"],
+        "shape_bound_ok": r["shape_bound_ok"],
+        "legs": legs,
+    }
+
+
 CONFIGS = {
     1: config1_trace_replay,
     2: config2_fuzz_style,
@@ -491,6 +547,7 @@ CONFIGS = {
     5: config5_multichip,
     6: config6_patched_fleet,
     7: config7_serving_plane,
+    8: config8_sharded_serving,
 }
 
 

@@ -257,6 +257,7 @@ def fuzz(
     chaos: Optional[str] = None,
     chaos_quiesce: int = 8,
     serve: bool = False,
+    serve_shards: int = 1,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -327,7 +328,37 @@ def fuzz(
 
     serve_plane = None
     serve_sessions: Dict[str, Any] = {}
-    if serve:
+    if serve and serve_shards > 1:
+        # Sharded mode (runtime/serve_shard.py): the fuzz replicas are
+        # replicas of the SAME document, spread round-robin across
+        # ``serve_shards`` universe shards as one ``doc`` replication
+        # group — the plane's own cross-shard pubsub fan-out and
+        # anti-entropy run under the same chaotic schedules as the
+        # engines, and every quiesce asserts byte-identical convergence
+        # across shards.
+        from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+        serve_plane = ShardedServePlane(
+            serve_shards,
+            start=False,  # manual stepping keeps the fuzz deterministic
+            batch_target=2 ** rng.randrange(2, 6),
+            deadline_ms=float(rng.choice([1, 5, 25])),
+            quantum=rng.choice([2, 4, 8]),
+        )
+        for d in docs:
+            serve_sessions[d.actor_id] = serve_plane.session(
+                f"s-{d.actor_id}",
+                replica=d.actor_id,
+                doc="fuzz-doc",
+                weight=rng.choice([1, 2, 4]),
+                priority=rng.choice(["interactive", "bulk"]),
+                record_stream=check_patches,
+            )
+        for d in docs:
+            serve_sessions[d.actor_id].submit([initial_change])
+        if serve_plane.drain() != 0:
+            raise RuntimeError("sharded plane failed to drain the genesis change")
+    elif serve:
         from peritext_tpu.ops import TpuUniverse
         from peritext_tpu.runtime.serve import ServePlane
 
@@ -356,26 +387,77 @@ def fuzz(
         if serve_plane is not None and changes:
             serve_sessions[actor_id].submit(list(changes))
 
-    def serve_check() -> None:
+    def serve_check(docs_synced: bool = True) -> None:
         """Catch each serve replica up to ITS doc's clock (dedup-idempotent
         redelivery from the durable log — under chaos the session's lane
         may be missing dropped deliveries the doc will only see at
         quiesce), drain, and assert byte-identity: serve spans == doc
         spans per replica, and each session's accumulated patch stream
-        reconstructs its replica."""
+        reconstructs its replica.
+
+        Sharded mode instead catches every session up to the LOG frontier
+        (the plane's own cross-shard fan-out already out-runs individual
+        docs), runs the plane's anti-entropy, and asserts byte-identical
+        convergence ACROSS shards; the serve-vs-doc comparison only
+        applies when the docs themselves are at the frontier
+        (``docs_synced`` — the chaos quiesce)."""
         if serve_plane is None:
             return
-        for d in docs:
-            serve_submit(
-                d.actor_id,
-                log.missing_changes(dict(d.clock), serve_uni.clock(d.actor_id)),
-            )
+        if serve_shards > 1:
+            frontier = log.clock()
+            for d in docs:
+                missing = log.missing_changes(
+                    frontier, serve_plane.clock(d.actor_id)
+                )
+                if missing:
+                    # Catch-up redelivery, not client traffic: bypass the
+                    # doc-group fan-out (every sibling is caught up from
+                    # the same durable log on its own line — publishing
+                    # the suffix N-1 more times would be O(N^2) pure
+                    # duplicates through the chaos site).
+                    serve_sessions[d.actor_id]._inner.submit(missing)
+            serve_plane.anti_entropy()
+        else:
+            for d in docs:
+                serve_submit(
+                    d.actor_id,
+                    log.missing_changes(dict(d.clock), serve_uni.clock(d.actor_id)),
+                )
         leftover = serve_plane.drain()
         if leftover:
             fail(
                 f"serving plane left {leftover} submission(s) undeliverable",
                 {"serve_stats": dict(serve_plane.stats)},
             )
+        if serve_shards > 1:
+            first_spans = None
+            for d in docs:
+                s_spans = serve_plane.spans(d.actor_id)
+                if first_spans is None:
+                    first_spans = s_spans
+                elif s_spans != first_spans:
+                    fail(
+                        f"cross-shard span divergence on {d.actor_id} "
+                        f"(shard {serve_plane.shard_of(d.actor_id)})",
+                        {"left": first_spans, "right": s_spans},
+                    )
+                if docs_synced:
+                    doc_spans = d.get_text_with_formatting(["text"])
+                    if s_spans != doc_spans:
+                        fail(
+                            f"serve/doc span divergence on {d.actor_id}",
+                            {"serveDoc": s_spans, "batchDoc": doc_spans},
+                        )
+                if check_patches:
+                    accumulated = accumulate_patches(
+                        serve_sessions[d.actor_id].patch_log
+                    )
+                    if accumulated != s_spans:
+                        fail(
+                            f"serve patch/batch de-sync on {d.actor_id}",
+                            {"patchDoc": accumulated, "batchDoc": s_spans},
+                        )
+            return
         serve_spans = serve_uni.spans_batch()
         for i, d in enumerate(docs):
             doc_spans = d.get_text_with_formatting(["text"])
@@ -550,7 +632,7 @@ def fuzz(
             if serve_plane is not None:
                 serve_plane.step()
                 if done % chaos_quiesce == 0:
-                    serve_check()
+                    serve_check(docs_synced=False)
             check_pair(left, right)
             verified = True
         # Progress AFTER the iteration's checks: a soak line only claims
@@ -577,7 +659,7 @@ def fuzz(
         quiesce_and_check()
     elif chaos_plan is None:
         # The serving plane must end drained and byte-identical too.
-        serve_check()
+        serve_check(docs_synced=False)
 
     return {
         "docs": docs,
@@ -609,6 +691,14 @@ def _main() -> None:
         help="also drive the serving plane (runtime/serve.py): one session "
         "per replica with rng-drawn weights/priorities/deadlines, stepped "
         "per iteration, byte-identity asserted at every check point",
+    )
+    parser.add_argument(
+        "--shards", type=int, default=1,
+        help="with --serve: partition the sessions across this many "
+        "universe shards (runtime/serve_shard.py) as one cross-shard "
+        "document group — the plane's pubsub fan-out + anti-entropy run "
+        "under the same chaotic delivery, and every quiesce asserts "
+        "byte-identical convergence across shards",
     )
     parser.add_argument(
         "--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC, default=None, metavar="SPEC",
@@ -687,7 +777,8 @@ def _main() -> None:
             clear_caches_every=args.clear_caches_every,
             chaos=args.chaos,
             chaos_quiesce=args.chaos_quiesce,
-            serve=args.serve,
+            serve=args.serve or args.shards > 1,
+            serve_shards=args.shards,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")

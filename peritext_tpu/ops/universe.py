@@ -770,6 +770,34 @@ class TpuUniverse:
             self.store_versions.append(0)
             self.text_objs.append(None)
 
+    def rename_replica(self, old: str, new: str) -> None:
+        """Rebind an EMPTY replica row to a new id — pure host
+        bookkeeping, zero device work.  The row must never have ingested
+        anything (empty clock); the sharded serving plane uses this to
+        hand a pow2-bucket pad row to a joining session without the
+        drop+add double state rebuild."""
+        if new in self.index_of:
+            raise ValueError(f"replica {new!r} already exists")
+        if old not in self.index_of:
+            raise KeyError(f"unknown replica {old!r}")
+        i = self.index_of[old]
+        if self.clocks[i]:
+            raise ValueError(
+                f"cannot rename non-empty replica {old!r} (clock "
+                f"{self.clocks[i]}); only untouched rows rebind"
+            )
+        del self.index_of[old]
+        self.replica_ids[i] = new
+        self.index_of[new] = i
+        # Reset the host planes to the founder state (the row never saw
+        # traffic, but a fresh store guards against aliasing a shared
+        # version-0 instance under the new name's future mutations —
+        # stores only ever swap via _prepare, which copies, so this is
+        # belt-and-braces, not a repair).
+        self.stores[i] = ObjectStore()
+        self.store_versions[i] = 0
+        self.text_objs[i] = None
+
     def drop_replicas(self, names: Sequence[str]) -> None:
         """Shrink the fleet (one gather; dropped replicas' state is gone —
         durable history lives in the change log, not the fleet)."""

@@ -11,6 +11,7 @@ reductions) that the sequence-sharded kernels need.
 from peritext_tpu.parallel.shard import flatten_sources_sp, merge_step_sorted_sp, place_text_sp
 from peritext_tpu.parallel.mesh import (
     make_mesh,
+    mesh_slices,
     shard_states,
     sharded_apply,
     sharded_digest_reduce,
@@ -19,6 +20,7 @@ from peritext_tpu.parallel.mesh import (
 
 __all__ = [
     "make_mesh",
+    "mesh_slices",
     "shard_states",
     "sharded_apply",
     "sharded_digest_reduce",

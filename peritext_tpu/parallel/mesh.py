@@ -26,6 +26,35 @@ from peritext_tpu.ops import kernels as K
 from peritext_tpu.ops.state import DocState
 
 
+def mesh_slices(
+    n_shards: int, devices: Optional[Sequence[jax.Device]] = None
+) -> list:
+    """Partition the device mesh into ``n_shards`` serving slices.
+
+    The sharded serving plane (runtime/serve_shard.py) runs one universe
+    shard per slice.  With shards <= devices each slice is a contiguous
+    device group (remainder devices land on the leading slices, so slice
+    sizes differ by at most one and a pow2 shard count over a pow2 mesh
+    tiles exactly); with more shards than devices, slices are singleton
+    and round-robin over the mesh — shards share chips but keep their
+    own universes/schedulers.
+    """
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    devices = list(devices if devices is not None else jax.devices())
+    n_dev = len(devices)
+    if n_shards >= n_dev:
+        return [[devices[i % n_dev]] for i in range(n_shards)]
+    base, extra = divmod(n_dev, n_shards)
+    slices = []
+    lo = 0
+    for i in range(n_shards):
+        hi = lo + base + (1 if i < extra else 0)
+        slices.append(devices[lo:hi])
+        lo = hi
+    return slices
+
+
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     replica_axis: Optional[int] = None,

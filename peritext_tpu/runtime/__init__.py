@@ -17,6 +17,7 @@ from peritext_tpu.runtime.serve import (
     ServeShedError,
     Submission,
 )
+from peritext_tpu.runtime.serve_shard import ShardedServePlane, ShardSession
 from peritext_tpu.runtime.sync import (
     ConvergenceError,
     apply_available,
@@ -41,6 +42,8 @@ __all__ = [
     "ServePlane",
     "ServeSession",
     "ServeShedError",
+    "ShardSession",
+    "ShardedServePlane",
     "Submission",
     "apply_available",
     "apply_changes",

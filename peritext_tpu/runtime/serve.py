@@ -343,9 +343,16 @@ class ServePlane:
         on_open: Optional[str] = None,
         start: bool = True,
         name: str = "serve",
+        shard: Optional[int] = None,
     ) -> None:
         self._uni = universe
         self.name = name
+        # Shard id when this plane is one slice of a ShardedServePlane
+        # (runtime/serve_shard.py): stamps the serve.submit causal lanes
+        # and the serve.flush spans, and keys the per-shard
+        # serve.shard.<i>.compile_cache_* counters so the shape-bucketing
+        # win is attributable per shard (the plane-global aggregate stays).
+        self.shard = shard
         self._batch_target = _bucket_pow2(
             max(1, batch_target if batch_target is not None
                 else _env_int("PERITEXT_SERVE_BATCH", 64))
@@ -371,6 +378,11 @@ class ServePlane:
         # drain waiters.
         self._work = threading.Condition(self._lock)
         self._flush_seq = 0
+        # True while a formed cohort's launch is in flight OUTSIDE the
+        # lock (step() releases _work for the device call).  run_quiesced
+        # waits on it: universe mutations (replica add/drop, resharding)
+        # must never interleave with a launch that is reading the state.
+        self._flush_busy = False
         self._closed = False
         self._drain_req = 0
         self._miss_streak = 0
@@ -452,11 +464,15 @@ class ServePlane:
         # reorder the submitted changes (client->server transport loss).
         faults.fire("serve_admit")
         changes = faults.filter_stream("serve_admit", changes, stream=session.name)
-        ctx = (
-            telemetry.flow("serve.submit", session=session.name, changes=len(changes))
-            if telemetry.enabled
-            else None
-        )
+        if telemetry.enabled:
+            flow_meta: Dict[str, Any] = {
+                "session": session.name, "changes": len(changes),
+            }
+            if self.shard is not None:
+                flow_meta["shard"] = self.shard
+            ctx = telemetry.flow("serve.submit", **flow_meta)
+        else:
+            ctx = None
         sub = Submission(session, changes, ctx)
         shed: List[Submission] = []
         with telemetry.span("serve.admit", session=session.name, changes=len(changes)):
@@ -748,20 +764,31 @@ class ServePlane:
         self._flush_seq += 1
         seq = self._flush_seq
         shape = cohort_shape_key(self._uni, per_replica)
-        hit = shape in self._shapes
-        self._shapes.add(shape)
-        self.stats["compile_cache_hits" if hit else "compile_cache_misses"] += 1
+        with self._lock:
+            # _flush runs outside _work (step released it before the
+            # launch); shape_keys()/stats readers on other threads need
+            # the mutation fenced.
+            hit = shape in self._shapes
+            self._shapes.add(shape)
+            self.stats["compile_cache_hits" if hit else "compile_cache_misses"] += 1
         if telemetry.enabled:
-            telemetry.counter(
-                "serve.compile_cache_hit" if hit else "serve.compile_cache_miss"
-            )
+            suffix = "compile_cache_hit" if hit else "compile_cache_miss"
+            telemetry.counter("serve." + suffix)
+            if self.shard is not None:
+                # Per-shard attribution (keyed, not instead of, the
+                # aggregate above): the shape-bucketing claim is judged
+                # shard by shard (tests/test_telemetry.py pins both).
+                telemetry.counter(f"serve.shard.{self.shard}.{suffix}")
         ctxs = tuple(s.ctx for s in subs if s.ctx is not None)
         err: Optional[BaseException] = None
         out = None
         t0 = time.perf_counter()
-        with telemetry.span(
-            "serve.flush", flush=seq, sessions=len(per_replica), changes=n_changes
-        ):
+        span_meta: Dict[str, Any] = {
+            "flush": seq, "sessions": len(per_replica), "changes": n_changes,
+        }
+        if self.shard is not None:
+            span_meta["shard"] = self.shard
+        with telemetry.span("serve.flush", **span_meta):
             for ctx in ctxs:
                 telemetry.flow_point(ctx)
             with telemetry.flowing(ctxs):
@@ -892,6 +919,11 @@ class ServePlane:
             else:
                 shed = None
                 formed = self._form_locked()
+                if formed is not None:
+                    # Mark the launch in flight BEFORE releasing the lock:
+                    # run_quiesced holds _work and waits for this flag, so
+                    # no universe mutation can interleave with the flush.
+                    self._flush_busy = True
         if shed is not None:
             if shed:
                 with telemetry.span("serve.hold_shed", plane=self.name):
@@ -903,8 +935,31 @@ class ServePlane:
             return bool(shed)
         if formed is None:
             return False
-        self._flush(formed)
+        try:
+            self._flush(formed)
+        finally:
+            with self._work:
+                self._flush_busy = False
+                self._work.notify_all()
         return True
+
+    def shape_keys(self) -> frozenset:
+        """The distinct cohort shape keys this plane has flushed — the
+        compile-cache pressure proxy.  The sharded plane unions these
+        across shards (equal-width shards share programs process-wide)."""
+        with self._lock:
+            return frozenset(self._shapes)
+
+    def run_quiesced(self, fn):
+        """Run ``fn`` while no cohort launch is in flight and none can
+        start (cohort formation takes the same lock this holds).  The
+        sharded plane routes universe mutations — replica add/drop,
+        mesh resharding — through this barrier: they rebuild the device
+        state a concurrent launch would be reading."""
+        with self._work:
+            while self._flush_busy:
+                self._work.wait()
+            return fn()
 
     def drain(self, max_steps: int = 1000) -> int:
         """Flush until every lane empties or no progress is possible
@@ -969,13 +1024,20 @@ class ServePlane:
 
     def flush_and_wait(self, timeout: float = 30.0) -> None:
         """Threaded-mode drain: ask the scheduler to flush everything
-        pending and wait until the lanes are empty."""
+        pending and wait until the lanes are empty AND no flush is in
+        flight.  (Admitted submissions leave their lane at cohort
+        FORMATION, before the launch — an empty lane alone does not mean
+        the last cohort's effects are visible, which bites callers that
+        submitted without wait=True.)"""
         deadline = time.monotonic() + timeout
         with self._work:
             self._drain_req += 1
             self._work.notify_all()
             try:
-                while any(s._lane for s in self._sessions.values()):
+                while (
+                    any(s._lane for s in self._sessions.values())
+                    or self._flush_busy
+                ):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise TimeoutError(

@@ -107,6 +107,14 @@ run_steps() {
     python3 -m peritext_tpu.bench.configs --config 5 --platform ambient --timeout 3500 || return 1
   probe || return 1
   step bench_r4096.json 2100 env BENCH_REPLICAS=4096 BENCH_TPU_TIMEOUT=2000 BENCH_PROBE_TIMEOUT=0 python3 bench.py || return 1
+  probe || return 1
+  # 8. Mesh-sharded serving scaling on the real device mesh (ISSUE 11 /
+  # ROADMAP hardware-truth item): the config-8 1-vs-8-shard A/B where the
+  # shards actually land on distinct chips — the CPU artifact
+  # (artifacts/serve_shard_ab_r09.jsonl) measures the row-sweep cut only;
+  # this step is where per-shard launch CONCURRENCY becomes real.
+  step config8_shards.json 3600 env CONFIG8_SHARDS=1,8 \
+    python3 -m peritext_tpu.bench.configs --config 8 --platform ambient --timeout 3500 || return 1
   return 0
 }
 

@@ -241,6 +241,68 @@ def _quantile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[i]
 
 
+def serve_shard_attribution(events, lanes) -> Optional[Dict[str, Any]]:
+    """Per-shard serving attribution (runtime/serve_shard.py): lane counts
+    by the shard id stamped on each ``serve.submit`` lane's start args,
+    per-shard cohort-launch (``serve.flush``) tallies, and cross-shard
+    flush overlap — wall-clock during which >= 2 distinct shards had a
+    cohort launch in flight, the concurrency claim made visible from the
+    trace alone.  Returns None when the trace carries no shard ids (an
+    unsharded plane)."""
+    flushes: Dict[Any, List[Tuple[float, float]]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "serve.flush":
+            shard = (e.get("args") or {}).get("shard")
+            if shard is not None:
+                flushes[shard].append((e["ts"], e["ts"] + e["dur"]))
+    lane_counts: Dict[Any, int] = defaultdict(int)
+    for lane in lanes.values():
+        if lane["kind"] == "serve.submit" and lane["meta"]:
+            shard = lane["meta"].get("shard")
+            if shard is not None:
+                lane_counts[shard] += 1
+    if not flushes and not lane_counts:
+        return None
+    # Sweep the flush intervals: busy = any shard launching, overlap =
+    # >= 2 distinct shards launching concurrently.
+    marks: List[Tuple[float, int, Any]] = []
+    for shard, ivals in flushes.items():
+        for lo, hi in ivals:
+            marks.append((lo, +1, shard))
+            marks.append((hi, -1, shard))
+    marks.sort(key=lambda m: (m[0], -m[1]))
+    active: Dict[Any, int] = defaultdict(int)
+    busy_us = overlap_us = 0.0
+    prev = None
+    for ts, delta, shard in marks:
+        if prev is not None and ts > prev:
+            distinct = sum(1 for n in active.values() if n > 0)
+            if distinct >= 1:
+                busy_us += ts - prev
+            if distinct >= 2:
+                overlap_us += ts - prev
+        active[shard] += delta
+        prev = ts
+    total_flush_us = sum(hi - lo for ivals in flushes.values() for lo, hi in ivals)
+    per_shard = {
+        str(shard): {
+            "lanes": lane_counts.get(shard, 0),
+            "flushes": len(flushes.get(shard, [])),
+            "flush_us": sum(hi - lo for lo, hi in flushes.get(shard, [])),
+        }
+        for shard in sorted(set(flushes) | set(lane_counts), key=str)
+    }
+    return {
+        "shards": len(per_shard),
+        "per_shard": per_shard,
+        "flush_busy_us": busy_us,
+        "flush_overlap_us": overlap_us,
+        # >1.0 means shards genuinely launched concurrently (sum of
+        # per-shard launch time exceeds the busy window it fit into).
+        "launch_concurrency": (total_flush_us / busy_us) if busy_us > 0 else 0.0,
+    }
+
+
 def analyze(events, top: int = 5) -> Dict[str, Any]:
     lanes = build_lanes(events)
     complete = [l for l in lanes.values() if l["complete"]]
@@ -300,6 +362,7 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
         "complete": len(complete),
         "incomplete": len(lanes) - len(complete),
         "problems": validate_flows(events),
+        "serve_shards": serve_shard_attribution(events, lanes),
         "phase_totals_us": dict(totals),
         "p50_us": _quantile(durs, 0.50),
         "p95_us": _quantile(durs, 0.95),
@@ -336,6 +399,18 @@ def format_report(a: Dict[str, Any]) -> str:
             lines.append(
                 f"  {name:<24} n={q['count']:<6} p50 {q['p50_us']:.0f}us  "
                 f"p95 {q['p95_us']:.0f}us  p99 {q['p99_us']:.0f}us"
+            )
+    if a.get("serve_shards"):
+        ss = a["serve_shards"]
+        lines.append(
+            f"serve shards: {ss['shards']}  launch concurrency "
+            f"{ss['launch_concurrency']:.2f}x  overlap "
+            f"{ss['flush_overlap_us']:.0f}us of {ss['flush_busy_us']:.0f}us busy"
+        )
+        for shard, d in ss["per_shard"].items():
+            lines.append(
+                f"  shard {shard:<3} lanes={d['lanes']:<6} "
+                f"flushes={d['flushes']:<5} flush={d['flush_us']:.0f}us"
             )
     total = sum(a["phase_totals_us"].values()) or 1.0
     lines.append("critical path (all complete lanes):")

@@ -338,6 +338,26 @@ def test_threaded_deadline_flush_and_wait():
         plane.close()
 
 
+def test_flush_and_wait_covers_in_flight_launch():
+    """flush_and_wait must not return while the last cohort's launch is
+    still in flight: admitted submissions leave their lanes at cohort
+    FORMATION, so an empty lane alone proves nothing for un-waited
+    submissions — the caller's next read would race the launch (found by
+    the ISSUE 11 sharded verify drive, where a late joiner's un-waited
+    anti-entropy catch-up read back an empty replica)."""
+    stream = author_stream("inflight", 3)
+    uni = TpuUniverse(["r0"])
+    plane = ServePlane(uni, batch_target=4096, deadline_ms=1.0)
+    try:
+        s = plane.session("s0", replica="r0")
+        s.submit(stream)  # deliberately un-waited
+        plane.flush_and_wait(timeout=60.0)
+        assert uni.clock("r0"), "flush_and_wait returned before the launch landed"
+        assert plane.stats["flushes"] >= 1
+    finally:
+        plane.close()
+
+
 # ---------------------------------------------------------------------------
 # Wedged backend: deadline/hold/shed policies
 # ---------------------------------------------------------------------------

@@ -1,0 +1,552 @@
+"""Mesh-sharded serving suite (runtime/serve_shard.py): session
+partitioning across N universe shards, pow2 shape-bucketed shard widths,
+mesh-slice placement, per-session byte-identity vs direct ingest
+(including under seeded chaos, breaker fast-fail, and the oracle-degrade
+path), cross-shard doc-group fan-out + anti-entropy convergence under
+chaotic delivery, and the per-shard trace attribution.
+
+The hard wall (ISSUE 11): sharding is a placement/scheduling decision,
+never a semantic — each session's concatenated patch stream must equal
+ingesting its changes one at a time, and replicas of the same document on
+different shards must converge byte-identically after anti-entropy.
+"""
+import os
+import random
+import sys
+
+import pytest
+
+from peritext_tpu.oracle import accumulate_patches
+from peritext_tpu.parallel.mesh import mesh_slices
+from peritext_tpu.runtime import faults, health, telemetry
+from peritext_tpu.runtime.faults import FaultPlan
+from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+from test_serve import author_stream, detached_telemetry, direct_streams  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    yield
+
+
+def sharded_streams(names, streams, rng, shards, **plane_kw):
+    """The per-session traffic through a manual-mode sharded plane with an
+    rng-drawn interleaving of submissions and step points."""
+    plane = ShardedServePlane(shards, start=False, **plane_kw)
+    sessions = [
+        plane.session(
+            f"s{i}",
+            replica=names[i],
+            weight=rng.choice([1, 3]),
+            priority=rng.choice(["interactive", "bulk"]),
+            record_stream=True,
+        )
+        for i in range(len(names))
+    ]
+    cursors = [0] * len(names)
+    while any(cursors[i] < len(streams[i]) for i in range(len(names))):
+        i = rng.randrange(len(names))
+        if cursors[i] >= len(streams[i]):
+            continue
+        k = min(rng.choice([1, 1, 2, 3]), len(streams[i]) - cursors[i])
+        sessions[i].submit(streams[i][cursors[i] : cursors[i] + k])
+        cursors[i] += k
+        if rng.random() < 0.3:
+            plane.step()
+    assert plane.drain() == 0
+    return plane, {names[i]: list(sessions[i].patch_log) for i in range(len(names))}
+
+
+# ---------------------------------------------------------------------------
+# The hard wall: byte-identity vs direct per-change ingest
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed,shards", [(0, 2), (1, 3), (2, 4), (3, 8)])
+def test_matrix_byte_identity_across_shards(seed, shards):
+    rng = random.Random(seed)
+    n = rng.choice([3, 4, 5])
+    streams = [
+        author_stream(f"sh{seed}_{i}", rng.choice([4, 7]), seed=seed * 10 + i)
+        for i in range(n)
+    ]
+    names = [f"r{i}" for i in range(n)]
+    plane, served = sharded_streams(
+        names, streams, rng, shards,
+        batch_target=rng.choice([4, 16]),
+        deadline_ms=5.0,
+    )
+    uni_d, direct = direct_streams(names, streams)
+    assert served == direct
+    for i, name in enumerate(names):
+        assert plane.spans(name) == uni_d.spans(name)
+    # Sessions actually spread over the shards (round-robin default).
+    used = {plane.shard_of(name) for name in names}
+    assert len(used) == min(shards, n)
+
+
+def test_single_shard_degenerates_to_serve_plane():
+    """shards=1 must behave exactly like one ServePlane (the A/B's
+    baseline leg is trustworthy only if this holds)."""
+    rng = random.Random(7)
+    streams = [author_stream("deg1_a", 5, seed=1), author_stream("deg1_b", 5, seed=2)]
+    names = ["r0", "r1"]
+    plane, served = sharded_streams(
+        names, streams, rng, 1, batch_target=8, deadline_ms=5.0
+    )
+    uni_d, direct = direct_streams(names, streams)
+    assert served == direct
+    assert len(plane.shards) == 1
+
+
+def test_byte_identity_on_degrade_and_breaker_fastfail():
+    """Every launch fails past the budget, then a tripped breaker
+    fast-fails: per-shard ingest completes on the oracle path and the
+    served streams stay byte-identical."""
+    rng = random.Random(4)
+    streams = [author_stream(f"shd_{i}", 4, seed=5 + i) for i in range(3)]
+    names = ["r0", "r1", "r2"]
+    with faults.injected(FaultPlan().with_site("device_launch", fail=10_000)):
+        with health.guarded("device_launch:threshold=1,cooldown=600"):
+            plane, served = sharded_streams(
+                names, streams, rng, 2, batch_target=8, deadline_ms=5.0
+            )
+            degraded = sum(
+                s.universe.stats["degraded_batches"]
+                for s in plane.shards
+                if s.universe is not None
+            )
+            assert degraded >= 2
+    uni_d, direct = direct_streams(names, streams)
+    assert served == direct
+
+
+# ---------------------------------------------------------------------------
+# Shape buckets + placement
+# ---------------------------------------------------------------------------
+
+
+def test_pow2_bucket_pads_shard_widths():
+    plane = ShardedServePlane(2, start=False, bucket="pow2")
+    for i in range(5):
+        plane.session(f"s{i}", replica=f"r{i}")
+    # Round-robin: shard 0 fronts 3 sessions (pow2 -> width 4), shard 1
+    # fronts 2 (width 2); pads are inert __pad replicas.
+    widths = [len(s.universe.replica_ids) for s in plane.shards]
+    assert widths == [4, 2]
+    assert sum(len(s.real) for s in plane.shards) == 5
+    pads = [
+        r for s in plane.shards for r in s.universe.replica_ids
+        if r.startswith("__pad")
+    ]
+    assert len(pads) == 1
+
+
+def test_pow2_bucket_width_is_exactly_pow2_at_every_count():
+    """The bucket INVARIANT, across the boundary where a new real session
+    must consume a pad row rather than push the width off-pow2: a shard
+    fronting n sessions runs a pow2(n)-wide universe, always."""
+    plane = ShardedServePlane(1, start=False, bucket="pow2")
+    widths = []
+    for i in range(9):
+        plane.session(f"s{i}", replica=f"r{i}")
+        widths.append(len(plane.shards[0].universe.replica_ids))
+    assert widths == [1, 2, 4, 4, 8, 8, 8, 8, 16]
+    # The consumed pads really left the universe (no orphan rows), and
+    # every real replica is still addressable.
+    uni = plane.shards[0].universe
+    assert sum(1 for r in uni.replica_ids if r.startswith("__pad")) == 16 - 9
+    for i in range(9):
+        assert f"r{i}" in uni.index_of
+    # Equal session counts -> equal widths -> shared cohort shapes: a
+    # second 9-session shard would compile nothing new (shape key is
+    # width+capacity+op buckets).
+    stream = author_stream("pw", 3)
+    s = plane._sessions["s0"]
+    s.submit(stream)
+    assert plane.drain() == 0
+
+
+def test_exact_bucket_skips_padding():
+    plane = ShardedServePlane(2, start=False, bucket="exact")
+    for i in range(5):
+        plane.session(f"s{i}", replica=f"r{i}")
+    widths = [len(s.universe.replica_ids) for s in plane.shards]
+    assert widths == [3, 2]
+
+
+def test_equal_width_shards_share_fleet_shapes():
+    """The shape-bucket claim: two equal-width shards flushing the same
+    cohort shape must count ONE fleet-wide compiled shape, not two."""
+    streams = [author_stream(f"fw_{i}", 3, seed=20 + i) for i in range(4)]
+    names = [f"r{i}" for i in range(4)]
+    plane = ShardedServePlane(2, start=False, batch_target=64)
+    sessions = [
+        plane.session(f"s{i}", replica=names[i]) for i in range(4)
+    ]
+    for i in range(4):
+        sessions[i].submit(streams[i])
+    assert plane.drain() == 0
+    st = plane.stats
+    per_shard_shapes = [
+        len(s.plane.shape_keys()) for s in plane.shards if s.plane is not None
+    ]
+    assert st["fleet_compiled_shapes"] <= sum(per_shard_shapes)
+    assert st["fleet_compiled_shapes"] <= max(per_shard_shapes) + 1
+
+
+def test_mesh_slices_partition():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8  # conftest's virtual mesh
+    slices = mesh_slices(4, devices=devs)
+    assert [len(s) for s in slices] == [2, 2, 2, 2]
+    assert [d for s in slices for d in s] == devs
+    slices = mesh_slices(3, devices=devs)
+    assert [len(s) for s in slices] == [3, 3, 2]
+    # More shards than devices: singleton round-robin slices.
+    slices = mesh_slices(12, devices=devs)
+    assert all(len(s) == 1 for s in slices)
+    assert [s[0] for s in slices[:8]] == devs
+    with pytest.raises(ValueError):
+        mesh_slices(0)
+
+
+def test_shard_universes_place_on_mesh_slices():
+    import jax
+
+    plane = ShardedServePlane(4, start=False)
+    for i in range(4):
+        plane.session(f"s{i}", replica=f"r{i}")
+    for shard in plane.shards:
+        leaf = jax.tree.leaves(shard.universe.states)[0]
+        assert shard.devices[0] in leaf.devices()
+
+
+def test_mesh_within_shard_keeps_byte_identity():
+    """A multi-device slice GSPMD-shards its universe's replica axis;
+    sharding must stay semantically invisible."""
+    rng = random.Random(9)
+    streams = [author_stream(f"msh_{i}", 4, seed=30 + i) for i in range(4)]
+    names = [f"r{i}" for i in range(4)]
+    plane = ShardedServePlane(
+        2, start=False, batch_target=8, mesh_within_shard=True
+    )
+    sessions = [
+        plane.session(f"s{i}", replica=names[i], record_stream=True)
+        for i in range(4)
+    ]
+    for i in range(4):
+        sessions[i].submit(streams[i])
+    assert plane.drain() == 0
+    uni_d, direct = direct_streams(names, streams)
+    assert {n: list(sessions[i].patch_log) for i, n in enumerate(names)} == direct
+    assert all(len(s.devices) == 4 for s in plane.shards)
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("PERITEXT_SERVE_SHARDS", "3")
+    plane = ShardedServePlane(start=False)
+    assert len(plane.shards) == 3
+    monkeypatch.setenv("PERITEXT_SERVE_SHARD_BUCKET", "exact")
+    assert ShardedServePlane(2, start=False).bucket == "exact"
+    monkeypatch.setenv("PERITEXT_SERVE_SHARD_BUCKET", "bogus")
+    with pytest.raises(ValueError):
+        ShardedServePlane(2, start=False)
+    with pytest.raises(ValueError):
+        ShardedServePlane(0, start=False)
+
+
+def test_env_default_plane_byte_identity():
+    """A default-constructed plane honors PERITEXT_SERVE_SHARDS (CI's
+    sharded leg pins 4; locally this degenerates to 1 shard) and stays
+    byte-identical either way."""
+    streams = [author_stream(f"env_{i}", 4, seed=50 + i) for i in range(3)]
+    names = [f"r{i}" for i in range(3)]
+    plane = ShardedServePlane(start=False, batch_target=8)
+    sess = [
+        plane.session(f"s{i}", replica=names[i], record_stream=True)
+        for i in range(3)
+    ]
+    for i in range(3):
+        sess[i].submit(streams[i])
+    assert plane.drain() == 0
+    uni_d, direct = direct_streams(names, streams)
+    assert {n: list(sess[i].patch_log) for i, n in enumerate(names)} == direct
+
+
+def test_universe_factory_owns_placement():
+    """A universe_factory plane never resolves mesh slices (no device
+    enumeration — the factory owns placement entirely) and still serves."""
+    from peritext_tpu.ops import TpuUniverse
+
+    made = []
+
+    def factory(ids, shard):
+        made.append(shard)
+        return TpuUniverse(list(ids))
+
+    plane = ShardedServePlane(2, start=False, universe_factory=factory)
+    s0 = plane.session("a", replica="ra", record_stream=True)
+    plane.session("b", replica="rb")
+    assert made == [0, 1]
+    assert all(s.devices is None for s in plane.shards)
+    stream = author_stream("fct", 3)
+    s0.submit(stream)
+    assert plane.drain() == 0
+    _, direct = direct_streams(["ra"], [stream])
+    assert list(s0.patch_log) == direct["ra"]
+
+
+def test_threaded_session_add_during_live_traffic():
+    """Threaded mode (the start=True default): opening a session on a
+    shard whose scheduler is mid-flush must quiesce the launch first
+    (ServePlane.run_quiesced) — replica add/drop rebuilds the device
+    state an in-flight launch reads.  Byte-identity is the witness."""
+    streams = [author_stream(f"live_{i}", 6, seed=60 + i) for i in range(3)]
+    names = [f"r{i}" for i in range(3)]
+    plane = ShardedServePlane(1, start=True, batch_target=4, deadline_ms=1.0)
+    try:
+        sessions = [
+            plane.session("s0", replica=names[0], record_stream=True)
+        ]
+        # Stream session 0's traffic through the live scheduler while two
+        # more sessions provision onto the same running shard.
+        for j, change in enumerate(streams[0]):
+            sessions[0].submit([change])
+            if j == 1:
+                sessions.append(
+                    plane.session("s1", replica=names[1], record_stream=True)
+                )
+            if j == 3:
+                sessions.append(
+                    plane.session("s2", replica=names[2], record_stream=True)
+                )
+        for i in (1, 2):
+            sessions[i].submit(streams[i])
+        plane.flush_and_wait(timeout=60.0)
+    finally:
+        plane.close()
+    uni_d, direct = direct_streams(names, streams)
+    assert {n: list(sessions[i].patch_log) for i, n in enumerate(names)} == direct
+
+
+def test_explicit_shard_pin_and_session_validation():
+    plane = ShardedServePlane(2, start=False)
+    a = plane.session("a", replica="ra", shard=1)
+    assert a.shard == 1 and plane.shard_of("ra") == 1
+    with pytest.raises(ValueError):
+        plane.session("a", replica="rb")
+    with pytest.raises(ValueError):
+        plane.session("b", replica="ra")
+    with pytest.raises(ValueError):
+        plane.session("b", replica="rb", shard=5)
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard anti-entropy (the doc replication group)
+# ---------------------------------------------------------------------------
+
+
+def _doc_group_plane(shards, members, **plane_kw):
+    plane = ShardedServePlane(shards, start=False, **plane_kw)
+    sessions = [
+        plane.session(f"g{i}", replica=f"gr{i}", doc="essay", record_stream=True)
+        for i in range(members)
+    ]
+    return plane, sessions
+
+
+def test_doc_group_fans_out_across_shards():
+    stream = author_stream("fan", 5)
+    plane, sessions = _doc_group_plane(3, 3, batch_target=8, deadline_ms=5.0)
+    assert {s.shard for s in sessions} == {0, 1, 2}
+    sessions[0].submit(stream)
+    assert plane.drain() == 0
+    spans = [plane.spans(s.replica) for s in sessions]
+    assert spans[0] == spans[1] == spans[2]
+    # Each replica's stream reconstructs it (byte-identity of the fanned
+    # deliveries).
+    for s in sessions:
+        assert accumulate_patches(s.patch_log) == plane.spans(s.replica)
+
+
+def test_doc_group_converges_under_chaotic_delivery():
+    """Seeded drop/dup/reorder on the cross-shard pubsub links: live
+    fan-out leaves gaps, anti-entropy redelivery closes them, and every
+    shard's replica converges byte-identically."""
+    stream = author_stream("chaosfan", 10)
+    plan = FaultPlan(seed=13).with_site(
+        "pubsub_deliver", drop=0.4, dup=0.2, reorder=0.3
+    )
+    with faults.injected(plan):
+        plane, sessions = _doc_group_plane(3, 3, batch_target=8, deadline_ms=5.0)
+        for change in stream:
+            sessions[0].submit([change])
+            plane.step()
+        plane.drain()
+    assert plan.stats["pubsub_deliver"]["dropped"] >= 1
+    # Fault-free anti-entropy from the group log quiesces the fleet.
+    plane.anti_entropy()
+    assert plane.drain() == 0
+    spans = [plane.spans(s.replica) for s in sessions]
+    assert spans[0] == spans[1] == spans[2]
+    uni_d, _ = direct_streams(["ref"], [[dict(c) for c in stream]])
+    assert spans[0] == uni_d.spans("ref")
+
+
+def test_doc_group_two_writers_converge():
+    """Two sessions of the same doc on different shards both write
+    concurrently; fan-out + anti-entropy merge them identically."""
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.runtime.sync import apply_changes
+
+    a, b = Doc("wa"), Doc("wb")
+    genesis, _ = a.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": list("base")},
+        ]
+    )
+    apply_changes(b, [genesis])
+    ca, _ = a.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": list("A")}]
+    )
+    cb, _ = b.change(
+        [{"path": ["text"], "action": "insert", "index": 4, "values": list("B")}]
+    )
+    plane, sessions = _doc_group_plane(2, 2, batch_target=8)
+    sessions[0].submit([genesis, ca])
+    sessions[1].submit([cb])
+    plane.drain()
+    plane.anti_entropy()
+    assert plane.drain() == 0
+    assert plane.spans(sessions[0].replica) == plane.spans(sessions[1].replica)
+    # The oracle pair agrees after its own sync.
+    apply_changes(a, [cb])
+    apply_changes(b, [ca])
+    oracle_spans = a.get_text_with_formatting(["text"])
+    assert plane.spans(sessions[0].replica) == oracle_spans
+
+
+def test_fanout_link_failure_never_voids_the_submission():
+    """Live cross-shard fan-out is best-effort: a failing delivery link
+    must not surface to the submitter or void its patches future — the
+    change is already in the group log, and anti-entropy redelivers."""
+    stream = author_stream("ffail", 4)
+    plan = FaultPlan(seed=2).with_site("pubsub_deliver", fail=2)
+    with faults.injected(plan):
+        plane, sessions = _doc_group_plane(2, 2, batch_target=8)
+        sub = sessions[0].submit(stream)  # must NOT raise
+        # The sibling's surviving deliveries sit behind the killed ones
+        # causally, so they may defer in-lane until anti-entropy.
+        plane.drain()
+    assert plan.stats["pubsub_deliver"]["failed"] >= 1
+    assert sub.done() and sub.result()  # the future survived the link loss
+    plane.anti_entropy()
+    assert plane.drain() == 0
+    assert plane.spans(sessions[0].replica) == plane.spans(sessions[1].replica)
+
+
+def test_rename_replica_rebinds_only_empty_rows():
+    """The pad-consume fast path (TpuUniverse.rename_replica): pure
+    bookkeeping for untouched rows, loud rejection otherwise."""
+    from peritext_tpu.ops import TpuUniverse
+
+    stream = author_stream("ren", 2)
+    uni = TpuUniverse(["live", "pad"])
+    uni.apply_changes_with_patches({"live": stream})
+    with pytest.raises(ValueError):
+        uni.rename_replica("live", "fresh")  # non-empty row
+    with pytest.raises(KeyError):
+        uni.rename_replica("ghost", "fresh")
+    with pytest.raises(ValueError):
+        uni.rename_replica("pad", "live")  # name collision
+    uni.rename_replica("pad", "fresh")
+    assert "pad" not in uni.index_of and uni.index_of["fresh"] == 1
+    # The rebound row serves traffic like any founder replica.
+    out = uni.apply_changes_with_patches({"fresh": [dict(c) for c in stream]})
+    assert out["fresh"]
+    assert uni.spans("fresh") == uni.spans("live")
+
+
+def test_group_log_rejects_forked_history():
+    from peritext_tpu.runtime.serve_shard import _GroupLog
+
+    log = _GroupLog()
+    log.record({"actor": "x", "seq": 1, "ops": [1]})
+    log.record({"actor": "x", "seq": 1, "ops": [1]})  # idempotent
+    with pytest.raises(ValueError):
+        log.record({"actor": "x", "seq": 1, "ops": [2]})
+    log.record({"actor": "x", "seq": 3, "ops": [3]})  # gap held back
+    assert [c["seq"] for c in log.contiguous({})] == [1]
+    log.record({"actor": "x", "seq": 2, "ops": [2.5]})
+    assert [c["seq"] for c in log.contiguous({})] == [1, 2, 3]
+    assert [c["seq"] for c in log.contiguous({"x": 2})] == [3]
+
+
+# ---------------------------------------------------------------------------
+# Trace attribution
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_trace_attributes_lanes_and_overlap(tmp_path, detached_telemetry):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import trace_report
+
+    trace = str(tmp_path / "shard_trace.jsonl")
+    telemetry.enable(trace=trace)
+    rng = random.Random(6)
+    streams = [author_stream(f"tr_{i}", 4, seed=40 + i) for i in range(4)]
+    sharded_streams(
+        [f"r{i}" for i in range(4)], streams, rng, 2,
+        batch_target=8, deadline_ms=5.0,
+    )
+    telemetry.flush_trace()
+    analysis = trace_report.analyze(trace_report.load_events(trace))
+    assert analysis["problems"] == []
+    ss = analysis["serve_shards"]
+    assert ss is not None and ss["shards"] == 2
+    assert sum(d["lanes"] for d in ss["per_shard"].values()) >= 4
+    assert all(d["flushes"] >= 1 for d in ss["per_shard"].values())
+    assert ss["flush_busy_us"] > 0
+    # Manual single-thread stepping cannot overlap launches; the field
+    # exists for the threaded A/B trace.
+    assert ss["flush_overlap_us"] >= 0.0
+    # An unsharded run reports no shard block.
+    trace2 = str(tmp_path / "flat_trace.jsonl")
+    telemetry.reset()
+    telemetry.enable(trace=trace2)
+    from test_serve import serve_streams
+
+    serve_streams(["r0"], [author_stream("flat", 3)], random.Random(1))
+    telemetry.flush_trace()
+    a2 = trace_report.analyze(trace_report.load_events(trace2))
+    assert a2["serve_shards"] is None
+
+
+# ---------------------------------------------------------------------------
+# Fuzz integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fuzz_sharded_serve_chaos_slice():
+    """The fuzzer's sharded-serve mode under chaotic delivery: sessions of
+    one document on different shards, full cross-shard convergence
+    asserts at every quiesce."""
+    from peritext_tpu.fuzz import DEFAULT_CHAOS_SPEC, fuzz
+
+    r = fuzz(
+        iterations=10,
+        seed=5,
+        chaos=DEFAULT_CHAOS_SPEC,
+        chaos_quiesce=5,
+        serve=True,
+        serve_shards=2,
+    )
+    assert r["serve_stats"]["flushes"] >= 1
+    assert len(r["serve_stats"]["shards"]) == 2

@@ -601,6 +601,58 @@ def test_serve_summary_section_rides_summary():
     assert s["serve"]["depth_max"] == 9
 
 
+def test_compile_cache_counters_keyed_per_shard():
+    """ISSUE 11 satellite: a sharded plane's compile-cache tallies are
+    keyed per shard (serve.shard.<i>.compile_cache_*) AND the plane-global
+    aggregate still counts every flush, so the shape-bucketing win stays
+    attributable shard by shard.  An unsharded plane emits no shard keys."""
+    from peritext_tpu.runtime.serve import ServePlane
+    from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+    telemetry.enable()
+    changes = _author_stream()
+    plane = ShardedServePlane(2, start=False, batch_target=8)
+    s0 = plane.session("s0", replica="r0")
+    s1 = plane.session("s1", replica="r1")
+    s0.submit(changes)
+    s1.submit([dict(c) for c in changes])
+    assert plane.drain() == 0
+    counters = telemetry.snapshot()["counters"]
+    for i, shard in enumerate(plane.shards):
+        per_shard = sum(
+            counters.get(f"serve.shard.{i}.compile_cache_{k}", 0)
+            for k in ("hit", "miss")
+        )
+        assert per_shard == shard.plane.stats["flushes"]
+        assert (
+            counters.get(f"serve.shard.{i}.compile_cache_miss", 0)
+            == shard.plane.stats["compile_cache_misses"]
+        )
+    aggregate = counters.get("serve.compile_cache_hit", 0) + counters.get(
+        "serve.compile_cache_miss", 0
+    )
+    assert aggregate == plane.stats["flushes"]
+    # The summary's serve section carries the per-shard keys too.
+    assert any(
+        k.startswith("shard.") for k in telemetry.summary()["serve"]
+    )
+    # Unsharded control: same counters, no shard keys.
+    telemetry.reset()
+    telemetry.enable()
+    uni = TpuUniverse(["r0"])
+    flat = ServePlane(uni, start=False, batch_target=8)
+    fs = flat.session("s0", replica="r0")
+    fs.submit([dict(c) for c in changes])
+    assert flat.drain() == 0
+    counters = telemetry.snapshot()["counters"]
+    assert not any(k.startswith("serve.shard.") for k in counters)
+    assert (
+        counters.get("serve.compile_cache_hit", 0)
+        + counters.get("serve.compile_cache_miss", 0)
+        == flat.stats["flushes"]
+    )
+
+
 def test_degraded_ingest_counts_in_registry():
     telemetry.enable()
     changes = _author_stream()
