@@ -439,12 +439,19 @@ def config6_patched_fleet() -> Dict[str, Any]:
         num_replicas=int(os.environ.get("CONFIG6_REPLICAS", "256")),
         rounds=int(os.environ.get("CONFIG6_ROUNDS", "4")),
         mode=mode,
+        # CONFIG6_LOCALITY=N confines each round's edits to one N-char
+        # hotspot (the editor-caret pattern): the regime where the
+        # frontier-bounded window merge engages (PERITEXT_MERGE_WINDOW).
+        # 0 (default) keeps the historical uniform-position baseline.
+        locality=int(os.environ.get("CONFIG6_LOCALITY", "0")),
     )
     return {
         "config": 6,
         "workload": f"{r['replicas']}-replica editor fleet, {r['rounds']} patched "
         f"ingest rounds, {r['doc_len']}-char docs",
         "path": r["path"],
+        "locality": r["locality"],
+        "windowed_launches": r["windowed_launches"],
         "patched_cold_ops_per_sec": round(r["patched_cold_ops_per_sec"], 1),
         "patched_warm_ops_per_sec": round(r["patched_warm_ops_per_sec"], 1),
         "no_patch_ops_per_sec": round(r["no_patch_ops_per_sec"], 1),

@@ -264,6 +264,28 @@ def main() -> None:
                     f"bench: planes readback fleet A/B measurement failed: {err}",
                     file=sys.stderr,
                 )
+            # Windowed-vs-full merge A/B (ISSUE 12): single-op latency at
+            # the tracked 10k-doc shape through the full universe API,
+            # identical seeded edit streams, digest-asserted identity.
+            try:
+                from peritext_tpu.bench.workloads import time_window_single_op
+
+                w_leg = time_window_single_op(windowed=True)
+                f_leg = time_window_single_op(windowed=False)
+                assert w_leg["digest"] == f_leg["digest"], "window A/B diverged"
+                result["windowed_p50_ms_10k_doc"] = w_leg["p50_ms"]
+                result["full_table_p50_ms_10k_doc"] = f_leg["p50_ms"]
+                if w_leg["p50_ms"]:
+                    result["window_p50_cut"] = round(
+                        f_leg["p50_ms"] / w_leg["p50_ms"], 2
+                    )
+                result["windowed_launches_10k"] = w_leg["windowed_launches"]
+                _emit(result)
+            except Exception as err:
+                print(
+                    f"bench: windowed merge A/B measurement failed: {err}",
+                    file=sys.stderr,
+                )
 
 
 if __name__ == "__main__":

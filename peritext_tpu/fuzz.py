@@ -532,6 +532,7 @@ def fuzz(
         serve_check()
 
     done = 0
+    max_doc_len = 0
     # True while chaotic syncs have happened since the last fault-free
     # quiesce (drives both the heartbeat wording and the mandatory final
     # quiesce — `done % chaos_quiesce` alone misses a no-op last iteration).
@@ -584,6 +585,7 @@ def fuzz(
         log.record(change)
         all_patches[target].extend(patches)
         serve_submit(doc.actor_id, [change])
+        max_doc_len = max(max_doc_len, _text_len(doc))
 
         left = rng.randrange(len(docs))
         right = rng.randrange(len(docs))
@@ -661,11 +663,23 @@ def fuzz(
         # The serving plane must end drained and byte-identical too.
         serve_check(docs_synced=False)
 
+    # Windowed-merge engagement across every device-backed replica (the
+    # frontier-bounded path, ISSUE 12): aggregated TpuUniverse stats, so a
+    # growth run's footer can report how often edits stayed O(window).
+    window_stats = {"launches": 0, "windowed_launches": 0, "window_fallbacks": 0}
+    for d in docs:
+        uni = getattr(d, "_uni", None)
+        if uni is not None:
+            for k in window_stats:
+                window_stats[k] += int(uni.stats.get(k, 0))
+
     return {
         "docs": docs,
         "log": log,
         "patches": all_patches,
         "iterations": done,
+        "max_doc_len": max_doc_len,
+        "window_stats": window_stats,
         "final_spans": docs[0].get_text_with_formatting(["text"]),
         "serve_stats": dict(serve_plane.stats) if serve_plane is not None else None,
     }
@@ -793,6 +807,20 @@ def _main() -> None:
         f"ok: {result['iterations']} iterations, final doc length "
         f"{sum(len(s['text']) for s in result['final_spans'])}"
     )
+    if args.growth:
+        ws = result["window_stats"]
+        engaged = (
+            100.0 * ws["windowed_launches"] / ws["launches"]
+            if ws["launches"]
+            else 0.0
+        )
+        print(
+            f"growth: sustained {sum(len(s['text']) for s in result['final_spans'])} "
+            f"chars (max {result['max_doc_len']}), windowed merge "
+            f"{ws['windowed_launches']}/{ws['launches']} launches "
+            f"({engaged:.0f}%), census fallbacks {ws['window_fallbacks']}",
+            flush=True,
+        )
 
 
 def _print_telemetry_summary() -> None:

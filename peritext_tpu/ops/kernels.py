@@ -2228,6 +2228,8 @@ def merge_step_sorted_patched(
     readback: str = "planes",
     span_cap: int = 8,
     cand_cap: int = 64,
+    vis_base: jax.Array | None = None,
+    vis_after: jax.Array | None = None,
 ):
     """Sorted merge that also emits per-op patch records.
 
@@ -2271,9 +2273,25 @@ def merge_step_sorted_patched(
     ``cand_cap`` statically sizes the compaction's defined-slot candidate
     axis from the host's mark-count mirror (defined slots never exceed 2x
     the mark table — see compact_mark_records).
+
+    ``vis_base``/``vis_after`` (traced scalars; None = whole-table merge)
+    re-anchor the record coordinates when the merge runs over a gathered
+    WINDOW of the document (the frontier-bounded path): every visibleIndex
+    the records carry is window-local, and the true index adds the count
+    of visible elements before the window (``vis_base``); the instant's
+    objLength adds the visible elements on both sides (text edits all land
+    inside the window, so both counts are batch-invariant).  The offsets
+    apply BEFORE span compaction so the finishPartialPatch filters and end
+    clamps run on global coordinates — byte-identical records to the
+    full-table merge on either readback format.
     """
 
     def _finish_records(records, cand_def):
+        if vis_base is not None:
+            records = dict(records)
+            records["index0"] = records["index0"] + vis_base
+            records["vis"] = records["vis"] + vis_base
+            records["obj_len"] = records["obj_len"] + vis_base + vis_after
         if readback != "compact":
             return records
         if cand_def is None:
@@ -2907,3 +2925,443 @@ def convergence_digest(state: DocState, ranks: jax.Array, multi: jax.Array) -> j
 
 
 convergence_digest_batch = jax.jit(jax.vmap(convergence_digest, in_axes=(0, None, None)))
+
+
+# ---------------------------------------------------------------------------
+# Frontier-bounded window merge: device compute proportional to the edit
+# ---------------------------------------------------------------------------
+#
+# Equivalence argument (mirroring the sorted-merge proof above).  Let W =
+# [lo, hi] be a contiguous element range of the committed document such that
+# for every op in the batch:
+#
+#   (i)   every insert's reference element and its ENTIRE skip run — the
+#         contiguous post-reference run of elements whose op ids exceed the
+#         *smallest* batch insert id (micromerge.ts:630-635) — lie in W, so
+#         every placement position t satisfies lo <= t <= hi+1, and t = hi+1
+#         only when hi is the last element of the document;
+#   (ii)  every delete's target lies in W;
+#   (iii) every mark op's start/end anchor slots, every DEFINED boundary
+#         slot inside its [start, end) walk range, and the nearest defined
+#         slot at or left of each anchor (the walk's carried currentOps
+#         source, peritext.ts:181-186) lie in W's slot range;
+#   (iv)  every insert's inherited-marks source (the nearest slot defined
+#         at the insert's instant strictly left of its gap,
+#         peritext.ts:328-330) lies in W's slot range.
+#
+# Then the merge restricted to the gathered window state — W's element and
+# boundary rows with length = |W|, plus the full (small) mark table — equals
+# the full-table merge restricted to W, and slots outside W are untouched by
+# the full-table merge: placement reads only (i)'s rows (the skip-run stop
+# rule never looks past the first non-skippable element, and window-local
+# positions are global positions minus lo); tombstoning writes only (ii)'s
+# rows; the mark walk reads/writes only (iii)'s slots, because in-range
+# writes require definedness and anchor writes copy (iii)'s carry rows; and
+# insert-row inheritance reads only (iv).  Scattering the merged window back
+# over [lo, hi] with the tail shifted by the insert count therefore
+# reproduces the full-table result exactly — states, patch records (with
+# the vis_base/vis_after re-anchoring), and winner-cache rows alike.
+# Visible indices decompose as global = local + vis_base because W is
+# contiguous and all visibility changes happen inside W.
+#
+# The window conditions are computed HOST-side from the universe's causal
+# mirror (ops/window.py); _window_ok re-verifies the membership conditions
+# on device against the gathered window and the batch itself, so a stale or
+# buggy census degrades to a full-table relaunch instead of corruption.
+
+
+def _gather_window(state: DocState, start, hull_len, w_cap: int) -> DocState:
+    """Slice a contiguous element window [start, start + w_cap) out of a
+    replica state as a self-contained DocState of capacity ``w_cap``.
+
+    ``length`` = ``hull_len`` (the census hull), so gathered slots beyond
+    the hull — present only because w_cap is pow2-bucketed — read as dead
+    padding to every kernel.  The mark table is small and rides whole.
+    ``start`` must satisfy ``start + w_cap <= capacity`` (the host census
+    clamps; dynamic_slice would silently re-anchor otherwise)."""
+    s = jnp.int32(start)
+
+    def win(p):
+        return lax.dynamic_slice_in_dim(p, s, w_cap)
+
+    return DocState(
+        elem_ctr=win(state.elem_ctr),
+        elem_act=win(state.elem_act),
+        deleted=win(state.deleted),
+        chars=win(state.chars),
+        bnd_def=lax.dynamic_slice_in_dim(state.bnd_def, 2 * s, 2 * w_cap),
+        bnd_mask=lax.dynamic_slice_in_dim(state.bnd_mask, 2 * s, 2 * w_cap, axis=0),
+        mark_ctr=state.mark_ctr,
+        mark_act=state.mark_act,
+        mark_action=state.mark_action,
+        mark_type=state.mark_type,
+        mark_attr=state.mark_attr,
+        length=jnp.int32(hull_len),
+        mark_count=state.mark_count,
+    )
+
+
+def _scatter_window(state: DocState, win: DocState, start, hull_len) -> DocState:
+    """Splice a merged window back into the full-capacity state.
+
+    Elements [0, start) keep their rows, [start, start + win.length) come
+    from the window, and the pre-batch tail shifts right by the insert
+    count (win.length - hull_len).  Slots at or beyond the new length are
+    masked to the dead-slot fills — the same convention the sort splice
+    leaves behind — so a windowed and a full-table merge of the same batch
+    produce byte-identical planes."""
+    c = state.capacity
+    w_cap = win.capacity
+    start = jnp.int32(start)
+    shift = win.length - jnp.int32(hull_len)
+    new_n = state.length + shift
+    ar = jnp.arange(c, dtype=jnp.int32)
+    in_win = (ar >= start) & (ar < start + win.length)
+    win_idx = jnp.clip(ar - start, 0, w_cap - 1)
+    old_idx = jnp.clip(jnp.where(ar < start, ar, ar - shift), 0, c - 1)
+
+    def mix(old, winp, fill):
+        v = jnp.where(in_win, winp[win_idx], old[old_idx])
+        return jnp.where(ar < new_n, v, fill)
+
+    ar2 = jnp.arange(2 * c, dtype=jnp.int32)
+    in_win2 = (ar2 >= 2 * start) & (ar2 < 2 * start + 2 * win.length)
+    win_idx2 = jnp.clip(ar2 - 2 * start, 0, 2 * w_cap - 1)
+    old_idx2 = jnp.clip(jnp.where(ar2 < 2 * start, ar2, ar2 - 2 * shift), 0, 2 * c - 1)
+    live2 = ar2 < 2 * new_n
+    bnd_def = jnp.where(
+        live2, jnp.where(in_win2, win.bnd_def[win_idx2], state.bnd_def[old_idx2]), False
+    )
+    bnd_mask = jnp.where(
+        live2[:, None],
+        jnp.where(
+            in_win2[:, None], win.bnd_mask[win_idx2], state.bnd_mask[old_idx2]
+        ),
+        jnp.uint32(0),
+    )
+    return DocState(
+        elem_ctr=mix(state.elem_ctr, win.elem_ctr, 0),
+        elem_act=mix(state.elem_act, win.elem_act, 0),
+        deleted=mix(state.deleted, win.deleted, False),
+        chars=mix(state.chars, win.chars, 0),
+        bnd_def=bnd_def,
+        bnd_mask=bnd_mask,
+        mark_ctr=win.mark_ctr,
+        mark_act=win.mark_act,
+        mark_action=win.mark_action,
+        mark_type=win.mark_type,
+        mark_attr=win.mark_attr,
+        length=new_n,
+        mark_count=win.mark_count,
+    )
+
+
+def _window_ok(win0: DocState, text_ops, mark_ops, w_cap: int):
+    """Device-side soundness check of the host window census.
+
+    Verifies, against the PRE-merge gathered window, the membership half of
+    the window conditions: every text op's reference (HEAD, a window
+    element, or a batch-created element), every mark anchor likewise, and
+    that the window has room for the batch's inserts.  A False verdict
+    makes the universe discard the windowed result and relaunch the
+    full-table path — the adaptive always-correct fallback.  (The skip-run
+    bound (i) is not re-checkable from the window alone; it holds because
+    the census computes it from the mirror, which is itself a readback of
+    committed device state.)"""
+    ln = win0.length
+    live = jnp.arange(w_cap, dtype=jnp.int32) < ln
+    kind = text_ops[:, K_KIND]
+    is_ins = (kind == KIND_INSERT) | (kind == KIND_INSERT_RUN)
+    is_del = kind == KIND_DELETE
+    k = jnp.where(kind == KIND_INSERT_RUN, text_ops[:, K_RUN_LEN], 1) * is_ins.astype(
+        jnp.int32
+    )
+
+    def found_in_win(qc, qa):
+        return jnp.any(
+            live[None, :]
+            & (win0.elem_ctr[None, :] == qc[:, None])
+            & (win0.elem_act[None, :] == qa[:, None]),
+            axis=1,
+        )
+
+    def found_in_batch(qc, qa):
+        return jnp.any(
+            is_ins[None, :]
+            & (qa[:, None] == text_ops[None, :, K_ACT])
+            & (qc[:, None] >= text_ops[None, :, K_CTR])
+            & (qc[:, None] < text_ops[None, :, K_CTR] + k[None, :]),
+            axis=1,
+        )
+
+    ref_ctr = text_ops[:, K_REF_CTR]
+    ref_act = text_ops[:, K_REF_ACT]
+    is_head = (ref_ctr == 0) & (ref_act == 0)
+    ref_ok = found_in_win(ref_ctr, ref_act) | found_in_batch(ref_ctr, ref_act)
+    text_ok = jnp.all(~(is_ins | is_del) | jnp.where(is_ins, is_head | ref_ok, ref_ok))
+
+    mvalid = mark_ops[:, K_KIND] == KIND_MARK
+    s_ok = found_in_win(mark_ops[:, K_SCTR], mark_ops[:, K_SACT]) | found_in_batch(
+        mark_ops[:, K_SCTR], mark_ops[:, K_SACT]
+    )
+    e_ok = (
+        (mark_ops[:, K_EKIND] == 2)
+        | found_in_win(mark_ops[:, K_ECTR], mark_ops[:, K_EACT])
+        | found_in_batch(mark_ops[:, K_ECTR], mark_ops[:, K_EACT])
+    )
+    mark_ok = jnp.all(~mvalid | (s_ok & e_ok))
+    fit_ok = ln + jnp.sum(k) <= w_cap
+    return text_ok & mark_ok & fit_ok
+
+
+def merge_step_sorted_windowed(
+    state: DocState,
+    start,
+    hull_len,
+    text_ops,
+    round_of,
+    num_rounds,
+    mark_ops,
+    ranks,
+    char_buf,
+    maxk: int,
+    w_cap: int,
+):
+    """merge_step_sorted over a gathered window, scattered back.
+
+    Returns ``(new_state, wrec)`` where wrec carries the device census
+    verdict (``wok``) and the post-merge window planes (``w_ctr``/``w_act``/
+    ``w_del``/``w_def``) the universe splices into its host mirror — so the
+    mirror stays a readback of device truth with O(window) transfer.  On
+    ``wok=False`` the returned state is meaningless and must be discarded
+    (the caller relaunches the full-table path)."""
+    win0 = _gather_window(state, start, hull_len, w_cap)
+    wok = _window_ok(win0, text_ops, mark_ops, w_cap)
+    new_win = merge_step_sorted(
+        win0, text_ops, round_of, num_rounds, mark_ops, ranks, char_buf, maxk
+    )
+    new_state = _scatter_window(state, new_win, start, hull_len)
+    wrec = {
+        "wok": wok,
+        "w_ctr": new_win.elem_ctr,
+        "w_act": new_win.elem_act,
+        "w_del": new_win.deleted,
+        "w_def": new_win.bnd_def,
+    }
+    return new_state, wrec
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_step_sorted_windowed_batch(maxk: int, w_cap: int):
+    return jax.jit(
+        jax.vmap(
+            functools.partial(merge_step_sorted_windowed, maxk=maxk, w_cap=w_cap),
+            in_axes=(0, 0, 0, 0, 0, None, 0, None, 0),
+        )
+    )
+
+
+def merge_step_sorted_windowed_batch(
+    states, starts, hull_lens, text_ops, round_of, num_rounds, mark_ops, ranks,
+    char_buf, maxk: int, w_cap: int,
+):
+    fn = _merge_step_sorted_windowed_batch(maxk, w_cap)
+    return fn(
+        states, starts, hull_lens, text_ops, round_of, jnp.int32(num_rounds),
+        mark_ops, ranks, char_buf,
+    )
+
+
+def _gather_wcache_window(wcache, start, w_cap: int):
+    return lax.dynamic_slice_in_dim(wcache, 2 * jnp.int32(start), 2 * w_cap, axis=0)
+
+
+def _scatter_wcache_window(wcache, win_rows, start, hull_len, win_len, old_len):
+    """Boundary-slot scatter of updated window winner-cache rows back into
+    the full [2C, T, 4] cache (same shift rule as _scatter_window; rows at
+    or beyond the new length mask to the empty entry, matching what a
+    fresh dominance init over zeroed rows produces)."""
+    two_c = wcache.shape[0]
+    w2 = win_rows.shape[0]
+    start = jnp.int32(start)
+    shift = jnp.int32(win_len) - jnp.int32(hull_len)
+    new_n2 = 2 * (jnp.int32(old_len) + shift)
+    ar2 = jnp.arange(two_c, dtype=jnp.int32)
+    in_win = (ar2 >= 2 * start) & (ar2 < 2 * start + 2 * jnp.int32(win_len))
+    win_idx = jnp.clip(ar2 - 2 * start, 0, w2 - 1)
+    old_idx = jnp.clip(jnp.where(ar2 < 2 * start, ar2, ar2 - 2 * shift), 0, two_c - 1)
+    empty = jnp.array([-1, -1, 0, 0], jnp.int32)
+    v = jnp.where(in_win[:, None, None], win_rows[win_idx], wcache[old_idx])
+    return jnp.where((ar2 < new_n2)[:, None, None], v, empty[None, None, :])
+
+
+def merge_step_sorted_patched_windowed(
+    state: DocState,
+    start,
+    hull_len,
+    vis_base,
+    vis_after,
+    text_ops,
+    round_of,
+    num_rounds,
+    mark_ops,
+    ranks,
+    char_buf,
+    multi,
+    text_time,
+    mark_time,
+    maxk: int,
+    has_marks: bool = True,
+    wcache_in: jax.Array | None = None,
+    mode: str = "delta",
+    group_k: int | None = None,
+    has_multi: bool = True,
+    t_act: int | None = None,
+    readback: str = "planes",
+    span_cap: int = 8,
+    cand_cap: int = 64,
+    w_cap: int = 256,
+):
+    """merge_step_sorted_patched over a gathered window, scattered back.
+
+    Records come out on GLOBAL visible coordinates (the vis_base/vis_after
+    re-anchoring runs before span compaction), so the host assemblers are
+    oblivious to windowing; ``wcache_in`` here is the FULL persisted cache
+    — its window rows ride the window merge and scatter back, so cache
+    warmth survives windowed ingests.  wrec extras as in
+    merge_step_sorted_windowed."""
+    win0 = _gather_window(state, start, hull_len, w_cap)
+    wok = _window_ok(win0, text_ops, mark_ops, w_cap)
+    wc_win = (
+        None if wcache_in is None else _gather_wcache_window(wcache_in, start, w_cap)
+    )
+    new_win, rec = merge_step_sorted_patched(
+        win0,
+        text_ops,
+        round_of,
+        num_rounds,
+        mark_ops,
+        ranks,
+        char_buf,
+        multi,
+        text_time,
+        mark_time,
+        maxk,
+        has_marks=has_marks,
+        wcache_in=wc_win,
+        mode=mode,
+        group_k=group_k,
+        has_multi=has_multi,
+        t_act=t_act,
+        readback=readback,
+        span_cap=span_cap,
+        cand_cap=cand_cap,
+        vis_base=vis_base,
+        vis_after=vis_after,
+    )
+    new_state = _scatter_window(state, new_win, start, hull_len)
+    wc = rec.pop("wcache", None)
+    if wcache_in is not None and wc is not None:
+        rec["wcache"] = _scatter_wcache_window(
+            wcache_in, wc, start, hull_len, new_win.length, state.length
+        )
+    rec["wok"] = wok
+    rec["w_ctr"] = new_win.elem_ctr
+    rec["w_act"] = new_win.elem_act
+    rec["w_del"] = new_win.deleted
+    rec["w_def"] = new_win.bnd_def
+    return new_state, rec
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_step_sorted_patched_windowed_batch(
+    maxk: int,
+    has_marks: bool,
+    has_wcache: bool,
+    mode: str,
+    group_k: int | None,
+    has_multi: bool,
+    t_act: int | None,
+    readback: str,
+    span_cap: int,
+    cand_cap: int,
+    w_cap: int,
+):
+    kw = dict(
+        maxk=maxk, has_marks=has_marks, mode=mode, group_k=group_k,
+        has_multi=has_multi, t_act=t_act, readback=readback, span_cap=span_cap,
+        cand_cap=cand_cap, w_cap=w_cap,
+    )
+    if has_wcache:
+
+        def call(st, s, h, vb, va, t, ro, nr, m, rk, b, mu, tt, mt, wc):
+            return merge_step_sorted_patched_windowed(
+                st, s, h, vb, va, t, ro, nr, m, rk, b, mu, tt, mt,
+                wcache_in=wc, **kw
+            )
+
+        return jax.jit(
+            jax.vmap(
+                call,
+                in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0, None, 0, None, 0, 0, 0),
+            )
+        )
+    return jax.jit(
+        jax.vmap(
+            functools.partial(merge_step_sorted_patched_windowed, **kw),
+            in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0, None, 0, None, 0, 0),
+        )
+    )
+
+
+def merge_step_sorted_patched_windowed_batch(
+    states,
+    starts,
+    hull_lens,
+    vis_base,
+    vis_after,
+    text_ops,
+    round_of,
+    num_rounds,
+    mark_ops,
+    ranks,
+    char_buf,
+    multi,
+    text_time,
+    mark_time,
+    maxk: int,
+    w_cap: int,
+    has_marks: bool = True,
+    wcache_in=None,
+    mode: str = "delta",
+    group_k: int | None = None,
+    has_multi: bool = True,
+    t_act: int | None = None,
+    readback: str = "planes",
+    span_cap: int = 8,
+    cand_cap: int = 64,
+):
+    """Jitted batched entry for the windowed patch-emitting sorted merge
+    (same static-arg normalization as merge_step_sorted_patched_batch)."""
+    if mode not in ("delta", "dense"):
+        raise ValueError(f"unknown patched merge mode {mode!r}")
+    if readback not in ("planes", "compact"):
+        raise ValueError(f"unknown patch readback format {readback!r}")
+    if mode == "dense" or not has_marks:
+        group_k, has_multi, t_act = None, True, None
+    if readback == "planes":
+        span_cap = 8
+    if readback == "planes" or not has_marks:
+        cand_cap = 64
+    fn = _merge_step_sorted_patched_windowed_batch(
+        maxk, has_marks, wcache_in is not None, mode, group_k, has_multi, t_act,
+        readback, span_cap, cand_cap, w_cap,
+    )
+    args = [
+        states, starts, hull_lens, vis_base, vis_after, text_ops, round_of,
+        jnp.int32(num_rounds), mark_ops, ranks, char_buf, multi, text_time,
+        mark_time,
+    ]
+    if wcache_in is not None:
+        args.append(wcache_in)
+    return fn(*args)

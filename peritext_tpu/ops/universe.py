@@ -30,6 +30,7 @@ import numpy as np
 
 from peritext_tpu.ids import ActorRegistry, make_op_id, parse_op_id
 from peritext_tpu.ops import kernels as K
+from peritext_tpu.ops import window as W
 from peritext_tpu.ops.encode import (
     AttrRegistry,
     TIME_PAD,
@@ -101,6 +102,49 @@ def _launch_policy() -> Tuple[int, float, float]:
 
 def _degrade_enabled() -> bool:
     return os.environ.get("PERITEXT_DEGRADE", "1") != "0"
+
+
+def _window_enabled() -> bool:
+    """Frontier-bounded window merge gate (PERITEXT_MERGE_WINDOW).
+
+    Default on; ``0`` pins the full-table path (the A/B baseline, and what
+    the test-window-off CI leg runs the differential suites under)."""
+    return os.environ.get("PERITEXT_MERGE_WINDOW", "1") != "0"
+
+
+def _window_min_cap() -> int:
+    """Smallest table capacity the windowed path engages at
+    (PERITEXT_MERGE_WINDOW_MIN, default 512): below it the gather/scatter
+    and census overhead dominate what the window saves."""
+    raw = os.environ.get("PERITEXT_MERGE_WINDOW_MIN", "512")
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PERITEXT_MERGE_WINDOW_MIN must be an integer, got {raw!r}"
+        )
+    if v < 1:
+        raise ValueError(f"PERITEXT_MERGE_WINDOW_MIN must be >= 1, got {v}")
+    return v
+
+
+def _window_backoff() -> int:
+    """Census-rejection backoff threshold (PERITEXT_WINDOW_BACKOFF,
+    default 4; 0 disables): after this many CONSECUTIVE census passes that
+    plan_windows rejected (hull too wide), the census — and with it the
+    per-batch mirror-rebuild D2H that full-table commits force — is
+    skipped for 2x-threshold batches before probing again.  Purely a cost
+    valve: skipped batches take the byte-identical full-table path."""
+    raw = os.environ.get("PERITEXT_WINDOW_BACKOFF", "4")
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PERITEXT_WINDOW_BACKOFF must be an integer, got {raw!r}"
+        )
+    if v < 0:
+        raise ValueError(f"PERITEXT_WINDOW_BACKOFF must be >= 0, got {v}")
+    return v
 
 
 def _patch_readback() -> str:
@@ -198,6 +242,25 @@ def _strip_pos(pairs: List[Any], with_positions: bool) -> List[Any]:
 # buffer; see faults.retryable): transient errors retry, semantic errors
 # propagate untouched.
 _retryable = faults.retryable
+
+
+_multi_cache: Dict[bytes, Any] = {}
+
+
+def _multi_jax():
+    """Device-resident allowMultiple flag vector, re-uploaded only when the
+    mark-type registry actually changes.  Per-ingest ``jnp.asarray`` of
+    the freshly built numpy vector cost one device_put per launch — fixed
+    overhead that dominates small windowed launches (PROFILE: ~0.1ms per
+    transfer on the 1-core box)."""
+    arr = allow_multiple_array()
+    key = arr.tobytes()
+    hit = _multi_cache.get(key)
+    if hit is None:
+        if len(_multi_cache) > 8:
+            _multi_cache.clear()
+        hit = _multi_cache[key] = jax.numpy.asarray(arr)
+    return hit
 
 
 def _blackbox_on_error(fn):
@@ -704,6 +767,37 @@ class TpuUniverse:
             self._span_cap = _initial_span_cap()
         else:
             self._span_cap = max(_initial_span_cap(), TpuUniverse._span_cap_floor)
+        # Causal mirror for the frontier-bounded window merge (ISSUE 12):
+        # per-replica numpy copies of the committed element ids, tombstone
+        # flags and boundary definedness, keyed to the states pytree OBJECT
+        # the copy was read from — any path that assigns ``self.states``
+        # without splicing the mirror (full-table merges, degrade, replica
+        # elasticity, external restores) invalidates it automatically, and
+        # the next window census lazily rebuilds it with one batched
+        # readback.  Windowed commits splice the post-merge window planes
+        # (read back with the records) instead, so the mirror is always a
+        # pure readback of device truth — never a host-side replay.
+        self._mirror: Optional[List[W.Mirror]] = None
+        self._mirror_token: Any = None
+        # Mirror equivalence classes: replicas with byte-equal mirrors
+        # share a class id, so a converged fleet ingesting one shared
+        # stream pays ONE census (and one mirror splice) per (class,
+        # group) instead of per replica.  Classes are content hashes at
+        # rebuild time and evolve deterministically on windowed commits
+        # (equal class + equal gated batch => equal spliced mirror).
+        self._mirror_class: List[Any] = []
+        self._mirror_class_counter = 0
+        # Census-rejection backoff: a streak of expensive census passes
+        # that plan_windows rejected (wide hulls) means this workload is
+        # paying a per-batch mirror rebuild (full-table commits invalidate
+        # the mirror) for nothing — skip the census for a few batches
+        # before probing again.
+        self._window_reject_streak = 0
+        self._window_census_skip = 0
+        # Device-resident actor-rank cache (re-upload only when the actor
+        # registry or its padded width changes — interning renumbers
+        # ranks, and both events change the key).
+        self._ranks_cache: Optional[List[Any]] = None
         # Lightweight observability counters (the reference's observability
         # is console logging + the demo op panel, SURVEY §5; at batch scale
         # these are what perf debugging needs).
@@ -862,6 +956,32 @@ class TpuUniverse:
         out = np.zeros(n, np.int32)
         out[: len(ranks)] = ranks
         return out
+
+    def _ranks_host(self) -> np.ndarray:
+        """Cached padded host rank table, rebuilt only when the actor
+        registry changes.  The key is checked BEFORE building the table:
+        interning an actor changes len(actors) (and possibly the padded
+        width), so a hit guarantees the cached table is current — the
+        window census and the device upload of one ingest share one build.
+        Callers treat the returned array as read-only."""
+        key = (len(self.actors.actors), self.max_actors)
+        c = self._ranks_cache
+        if c is not None and c[0] == key:
+            return c[1]
+        ranks = self._ranks()  # may grow max_actors: re-key below
+        self._ranks_cache = [
+            (len(self.actors.actors), self.max_actors), ranks, None
+        ]
+        return ranks
+
+    def _ranks_jax(self):
+        """Device-resident rank table (one upload per registry change, not
+        per launch — transfer overhead dominates small windowed launches)."""
+        host = self._ranks_host()
+        c = self._ranks_cache
+        if c[2] is None:
+            c[2] = jax.numpy.asarray(host)
+        return c[2]
 
     # -- resilient launch policy -------------------------------------------
 
@@ -1201,6 +1321,213 @@ class TpuUniverse:
             default=0,
         )
 
+    # -- frontier-bounded window merge: host census + causal mirror ----------
+
+    def _mirrors(self) -> List[W.Mirror]:
+        """Per-replica causal mirrors, rebuilt lazily (one batched D2H
+        readback of committed state) whenever any non-windowed path
+        reassigned ``self.states`` since the last windowed commit."""
+        if self._mirror_token is self.states and self._mirror is not None:
+            return self._mirror
+        ec = np.asarray(self.states.elem_ctr)
+        ea = np.asarray(self.states.elem_act)
+        dl = np.asarray(self.states.deleted)
+        bd = np.asarray(self.states.bnd_def)
+        # Byte-equal replicas share ONE Mirror instance (keyed by the same
+        # content hash that forms their census class): a converged fleet
+        # rebuild copies O(classes * n), not O(R * n).  Safe to share
+        # because mirrors are treated as immutable everywhere — splices
+        # replace them, never mutate in place.
+        mirrors: List[W.Mirror] = []
+        classes: List[Any] = []
+        shared: Dict[str, W.Mirror] = {}
+        for r, n in enumerate(self.lengths):
+            digest = hashlib.sha1(
+                b"".join((
+                    ec[r, :n].tobytes(),
+                    ea[r, :n].tobytes(),
+                    dl[r, :n].tobytes(),
+                    bd[r, : 2 * n].tobytes(),
+                ))
+            ).hexdigest()
+            m = shared.get(digest)
+            if m is None:
+                m = W.make_mirror(
+                    ec[r, :n].copy(), ea[r, :n].copy(), dl[r, :n].copy(),
+                    bd[r, : 2 * n].copy(),
+                )
+                shared[digest] = m
+            mirrors.append(m)
+            classes.append(digest)
+        self._mirror = mirrors
+        self._mirror_class = classes
+        self._mirror_token = self.states
+        self.stats["window_rebuilds"] = self.stats.get("window_rebuilds", 0) + 1
+        if telemetry.enabled:
+            telemetry.counter("ingest.window_rebuilds")
+            telemetry.counter(
+                "ingest.d2h_bytes",
+                int(ec.nbytes + ea.nbytes + dl.nbytes + bd.nbytes),
+            )
+        return self._mirror
+
+    def _window_plan(self, prep: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Window plan for a prepared batch, or None for the full path.
+
+        Gating: PERITEXT_MERGE_WINDOW=0 pins full; chunked launches
+        (PERITEXT_SORTED_CHUNK / PERITEXT_PATCH_CHUNK) slice the replica
+        axis and stay full-table; small documents
+        (< PERITEXT_MERGE_WINDOW_MIN) aren't worth the gather/scatter; and
+        plan_windows itself falls back when any replica's census cannot
+        bound its batch or the bucketed window would cover more than half
+        the table."""
+        if not _window_enabled():
+            return None
+        if os.environ.get("PERITEXT_SORTED_CHUNK") or os.environ.get(
+            "PERITEXT_PATCH_CHUNK"
+        ):
+            return None
+        if self.capacity < _window_min_cap():
+            return None
+        groups, group_of = prep["groups"], prep["group_of"]
+        n = len(self.replica_ids)
+        rows_of = [groups[group_of[r]]["rows"] for r in range(n)]
+        ins_of = [int(groups[group_of[r]]["inserts"]) for r in range(n)]
+        # Genesis fast-reject BEFORE the mirror readback: a replica whose
+        # batch carries rows while its document is empty always falls back
+        # (replica_window returns None on n == 0), so don't pay a
+        # fleet-wide D2H rebuild to find that out.
+        if any(
+            self.lengths[r] == 0 and rows_of[r].shape[0] for r in range(n)
+        ):
+            return None
+        # Backoff after a rejection streak: every census below this point
+        # costs a mirror rebuild (full-table commits invalidated it), so a
+        # workload whose hulls are persistently too wide would otherwise
+        # pay a fleet-wide D2H per batch with nothing to show for it.
+        if self._window_census_skip > 0:
+            self._window_census_skip -= 1
+            self.stats["window_census_skips"] = (
+                self.stats.get("window_census_skips", 0) + 1
+            )
+            if telemetry.enabled:
+                telemetry.counter("ingest.window_census_skips")
+            return None
+        ranks = self._ranks_host()
+        with telemetry.span("ingest.window_census"):
+            mirrors = self._mirrors()
+            keys = [
+                (self._mirror_class[r], int(group_of[r])) for r in range(n)
+            ]
+            plan = W.plan_windows(
+                mirrors, rows_of, ins_of, ranks, self.capacity,
+                _window_min_cap(), census_keys=keys,
+            )
+        if plan is None:
+            self._window_reject_streak += 1
+            threshold = _window_backoff()
+            if threshold and self._window_reject_streak >= threshold:
+                self._window_census_skip = 2 * threshold
+                self._window_reject_streak = 0
+        else:
+            self._window_reject_streak = 0
+            if telemetry.enabled:
+                telemetry.counter("ingest.window_planned")
+                telemetry.observe(
+                    "ingest.window_frac",
+                    int(round(100 * plan["w_cap"] / self.capacity)),
+                )
+        return plan
+
+    def _mirror_commit(
+        self, wplan: Dict[str, Any], wrec: Dict[str, np.ndarray], prep: Dict[str, Any]
+    ) -> None:
+        """Splice a windowed launch's post-merge window readback into the
+        mirrors and re-key them to the just-committed states pytree.  Runs
+        after ``self.states`` is assigned (the token must key the NEW
+        pytree); only the group insert counts are read from ``prep``, so
+        ordering against ``_commit`` doesn't matter."""
+        groups, group_of = prep["groups"], prep["group_of"]
+        starts, hulls = wplan["starts"], wplan["hulls"]
+        mirrors = self._mirror
+        assert mirrors is not None
+        # Splice + class evolution deduped per (mirror class, group):
+        # byte-equal mirrors ingesting the same gated batch produce
+        # byte-equal spliced mirrors, so class members SHARE the spliced
+        # arrays (mirrors are replaced, never mutated in place) and the
+        # new class id.
+        shared: Dict[Any, Tuple[W.Mirror, int]] = {}
+        for r in range(len(self.replica_ids)):
+            hull = int(hulls[r])
+            ins = int(groups[group_of[r]]["inserts"])
+            if hull == 0 and ins == 0:
+                continue
+            key = (self._mirror_class[r], int(group_of[r]))
+            hit = shared.get(key)
+            if hit is None:
+                self._mirror_class_counter += 1
+                hit = (
+                    W.splice_mirror(
+                        mirrors[r],
+                        int(starts[r]),
+                        hull,
+                        hull + ins,
+                        wrec["w_ctr"][r],
+                        wrec["w_act"][r],
+                        wrec["w_del"][r],
+                        wrec["w_def"][r],
+                    ),
+                    self._mirror_class_counter,
+                )
+                shared[key] = hit
+            mirrors[r], self._mirror_class[r] = hit
+        self._mirror_token = self.states
+
+    def _assert_states_match(self, ref, got, wplan, prep) -> None:
+        """PERITEXT_WINDOW_CHECK helper: compare a windowed result against
+        the full-table recompute of the same batch, field by field."""
+        import dataclasses as _dc
+
+        for f in _dc.fields(ref):
+            a = np.asarray(getattr(ref, f.name))
+            b = np.asarray(getattr(got, f.name))
+            if not (a == b).all():
+                bad = np.argwhere(a != b)
+                groups, group_of = prep["groups"], prep["group_of"]
+                rows = {
+                    r: groups[group_of[r]]["rows"].tolist()
+                    for r in set(int(x[0]) for x in bad[:8])
+                }
+                raise RuntimeError(
+                    "windowed merge diverged from full-table on plane "
+                    f"{f.name}: first diffs {bad[:8].tolist()}; wplan starts="
+                    f"{wplan['starts'].tolist()} hulls={wplan['hulls'].tolist()} "
+                    f"w_cap={wplan['w_cap']}; rows={rows}"
+                )
+
+    def _window_fallback(
+        self, launches: int = 1, d2h_bytes: int = 0, elapsed: float = 0.0
+    ) -> None:
+        """Tally a windowed launch the device census check rejected (stale
+        mirror / census bug): the caller discards the result and relaunches
+        the full-table path — correctness never depends on the census.  The
+        rejected launch still ran to completion on device, so it stays in
+        the launch/traffic/latency accounting (its window readback is real
+        D2H traffic; the op-tensor H2D upload is shared with the relaunch
+        and tallied once, at the relaunch's commit)."""
+        self.stats["window_fallbacks"] = self.stats.get("window_fallbacks", 0) + 1
+        self.stats["launches"] += launches
+        self.stats["dispatch_seconds"] += elapsed
+        _log.warning(
+            "windowed merge census check failed on device; relaunching the "
+            "full-table path"
+        )
+        if telemetry.enabled:
+            telemetry.counter("ingest.window_fallbacks")
+            telemetry.counter("ingest.launches", launches)
+            if d2h_bytes:
+                telemetry.counter("ingest.d2h_bytes", d2h_bytes)
+
     # -- oracle degradation (the CPU fallback after retry exhaustion) --------
 
     def _degrade_apply(self, prep: Dict[str, Any]) -> Dict[int, List[Any]]:
@@ -1523,33 +1850,118 @@ class TpuUniverse:
         mark_ops = g_mark[group_of]
         bufs = sorted_prep["bufs"][group_of]
         rounds = sorted_prep["rounds"][group_of]
-        ranks = self._ranks()
+        ranks = self._ranks_jax()
         pad_per_group = (sorted_prep["text"][:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1) + (
             g_mark[:, :, K.K_KIND] == K.KIND_PAD
         ).sum(axis=1)
         self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
+        # Frontier-bounded window merge (ISSUE 12): when the host census can
+        # bound every op's reach, gather the window, merge O(window), and
+        # scatter back — the full-table path stays the adaptive fallback.
+        wplan = None if use_scan else self._window_plan(prep)
+        # ONE batched host->device transfer for the launch's op tensors
+        # (per-array device_put overhead dominates small windowed
+        # launches); retries and the census-rejection relaunch reuse them.
+        if wplan is not None:
+            d_text, d_rounds, d_mark, d_bufs, d_wstart, d_whull = jax.device_put(
+                (text_ops, rounds, mark_ops, bufs, wplan["starts"], wplan["hulls"])
+            )
+        else:
+            d_text, d_rounds, d_mark, d_bufs = jax.device_put(
+                (text_ops, rounds, mark_ops, bufs)
+            )
         t_dev = time.perf_counter()
         self.stats["host_seconds"] += t_dev - t_host
+
+        strict = os.environ.get("PERITEXT_STRICT_COMMIT") == "1"
+        if wplan is not None:
+
+            def wattempt():
+                faults.fire("device_launch")
+                st, wrec = K.merge_step_sorted_windowed_batch(
+                    self.states,
+                    d_wstart,
+                    d_whull,
+                    d_text,
+                    d_rounds,
+                    sorted_prep["num_rounds"],
+                    d_mark,
+                    ranks,
+                    d_bufs,
+                    sorted_prep["maxk"],
+                    wplan["w_cap"],
+                )
+                faults.fire("device_readback")
+                # The census-verdict + mirror readback IS this path's
+                # barrier (the windowed merge trades launch pipelining for
+                # O(window) compute; the readback is window-sized).
+                wrec_np = jax.device_get(wrec)
+                return (st, wrec_np), st.length
+
+            try:
+                new_states, wrec_np = self._run_launch(wattempt, needs_barrier=strict)
+            except DeviceLaunchError:
+                if not _degrade_enabled():
+                    raise
+                self._degrade_apply(prep)
+                self.stats["dispatch_seconds"] += time.perf_counter() - t_dev
+                return
+            if bool(wrec_np["wok"].all()):
+                self.states = new_states
+                self.stats["launches"] += 1
+                self.stats["windowed_launches"] = (
+                    self.stats.get("windowed_launches", 0) + 1
+                )
+                self.stats["dispatch_seconds"] += time.perf_counter() - t_dev
+                if telemetry.enabled:
+                    telemetry.flow_steps(path="windowed", window=int(wplan["w_cap"]))
+                    telemetry.counter("ingest.launches")
+                    telemetry.counter("ingest.path.sorted")
+                    telemetry.counter("ingest.path.windowed")
+                    telemetry.counter(
+                        "ingest.h2d_bytes",
+                        int(
+                            text_ops.nbytes
+                            + mark_ops.nbytes
+                            + bufs.nbytes
+                            + rounds.nbytes
+                        ),
+                    )
+                    telemetry.counter(
+                        "ingest.d2h_bytes",
+                        int(sum(v.nbytes for v in wrec_np.values())),
+                    )
+                    telemetry.observe(
+                        "ingest.dispatch_seconds", time.perf_counter() - t_dev
+                    )
+                self._wcaches = None
+                self._mirror_commit(wplan, wrec_np, prep)
+                t_host = time.perf_counter()
+                self._commit(prep)
+                self.stats["host_seconds"] += time.perf_counter() - t_host
+                return
+            # Device census check rejected the window: relaunch full-table.
+            self._window_fallback(
+                d2h_bytes=int(sum(v.nbytes for v in wrec_np.values())),
+                elapsed=time.perf_counter() - t_dev,
+            )
+            t_dev = time.perf_counter()
 
         def attempt():
             faults.fire("device_launch")
             if use_scan:
                 st = K.merge_step_fused_batch(
-                    self.states,
-                    jax.numpy.asarray(text_ops),
-                    jax.numpy.asarray(mark_ops),
-                    jax.numpy.asarray(ranks),
-                    jax.numpy.asarray(bufs),
+                    self.states, d_text, d_mark, ranks, d_bufs
                 )
             else:
                 st = K.merge_step_sorted_batch(
                     self.states,
-                    jax.numpy.asarray(text_ops),
-                    jax.numpy.asarray(rounds),
+                    d_text,
+                    d_rounds,
                     sorted_prep["num_rounds"],
-                    jax.numpy.asarray(mark_ops),
-                    jax.numpy.asarray(ranks),
-                    jax.numpy.asarray(bufs),
+                    d_mark,
+                    ranks,
+                    d_bufs,
                     sorted_prep["maxk"],
                 )
             return st, st.length
@@ -1561,8 +1973,8 @@ class TpuUniverse:
         # trades pipelining for commit-after-*execution* — use it on flaky
         # backends (e.g. the relayed TPU).  The barrier runs inside the
         # retry attempt, so a readback failure consumes retry budget and
-        # leaves the committed state untouched.
-        strict = os.environ.get("PERITEXT_STRICT_COMMIT") == "1"
+        # leaves the committed state untouched.  (``strict`` was resolved
+        # above, before the windowed branch.)
         try:
             new_states = self._run_launch(attempt, needs_barrier=strict)
         except DeviceLaunchError:
@@ -1778,6 +2190,7 @@ class TpuUniverse:
                 group_sizes,
                 multi_need,
                 with_positions=with_positions,
+                wplan=self._window_plan(prep),
             )
         return self._patched_scan(
             prep, host_patches_for, group_sizes, max_rows, with_positions=with_positions
@@ -1793,8 +2206,9 @@ class TpuUniverse:
         pad = bucket_length(max_rows)
         g_ops = np.stack([pad_rows(g["rows"], pad) for g in groups])
         ops = g_ops[group_of]
-        ranks = jax.numpy.asarray(self._ranks())
-        multi = jax.numpy.asarray(allow_multiple_array())
+        d_ops = jax.device_put(ops)
+        ranks = self._ranks_jax()
+        multi = _multi_jax()
         pad_per_group = (g_ops[:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1)
         self.stats["rows_padded"] += int((pad_per_group * group_sizes).sum())
 
@@ -1823,7 +2237,7 @@ class TpuUniverse:
                     faults.fire("device_launch")
                     st, records = K.apply_ops_patched_batch(
                         jax.tree.map(lambda x: x[sl], self.states),
-                        jax.numpy.asarray(ops[sl]),
+                        d_ops[sl],
                         ranks,
                         multi,
                         readback=rb,
@@ -1831,15 +2245,13 @@ class TpuUniverse:
                     )
                     state_slices.append(st)
                     faults.fire("device_readback")
-                    # The np.asarray barrier IS the record D2H transfer —
+                    # The device_get barrier IS the record D2H transfer —
                     # span it here so the critical-path report attributes
                     # readback time separately from device dispatch.
                     with telemetry.span("ingest.readback", readback=rb, chunk=i):
                         if telemetry.enabled:
                             telemetry.flow_steps(readback=rb)
-                        record_chunks.append(
-                            {k: np.asarray(v) for k, v in records.items()}
-                        )
+                        record_chunks.append(jax.device_get(records))
                 states = (
                     state_slices[0]
                     if len(state_slices) == 1
@@ -1913,6 +2325,7 @@ class TpuUniverse:
         sizes,
         multi_need: int = 0,
         with_positions: bool = False,
+        wplan: Optional[Dict[str, Any]] = None,
     ):
         """The patch-emitting sorted merge: placement rounds + mark-only
         scan + analytic text records (kernels.merge_step_sorted_patched).
@@ -1970,8 +2383,28 @@ class TpuUniverse:
         text_pos = sorted_prep["text_pos"][group_of]
         mark_ops = g_mark[group_of]
         mark_pos = g_mark_pos[group_of]
-        ranks = jax.numpy.asarray(self._ranks())
-        multi = jax.numpy.asarray(allow_multiple_array())
+        ranks = self._ranks_jax()
+        multi = _multi_jax()
+        # ONE batched host->device transfer for the whole launch's op
+        # tensors (a device_put per array cost ~0.1ms fixed overhead each
+        # on the build box — at windowed single-op latencies that was the
+        # dominant term); retries and overflow relaunches reuse the same
+        # device arrays.
+        if wplan is not None:
+            (
+                d_text, d_rounds, d_bufs, d_tpos, d_mark, d_mpos,
+                d_wstart, d_whull, d_wvb, d_wva,
+            ) = jax.device_put(
+                (
+                    text_ops, rounds, bufs, text_pos, mark_ops, mark_pos,
+                    wplan["starts"], wplan["hulls"], wplan["vis_base"],
+                    wplan["vis_after"],
+                )
+            )
+        else:
+            d_text, d_rounds, d_bufs, d_tpos, d_mark, d_mpos = jax.device_put(
+                (text_ops, rounds, bufs, text_pos, mark_ops, mark_pos)
+            )
         pad_per_group = (sorted_prep["text"][:, :, K.K_KIND] == K.KIND_PAD).sum(
             axis=1
         ) + (g_mark[:, :, K.K_KIND] == K.KIND_PAD).sum(axis=1)
@@ -1998,8 +2431,48 @@ class TpuUniverse:
         span_cap = self._span_cap
         cand_cap = self._cand_cap(prep)
 
-        def make_attempt(rb: str):
+        def make_attempt(rb: str, windowed: bool = False):
             def attempt():
+                if windowed:
+                    # Frontier-bounded window merge: one launch over the
+                    # gathered [R, w_cap] windows (never chunked — a window
+                    # plan is only produced with the chunk valves unset).
+                    faults.fire("device_launch")
+                    st, records = K.merge_step_sorted_patched_windowed_batch(
+                        self.states,
+                        d_wstart,
+                        d_whull,
+                        d_wvb,
+                        d_wva,
+                        d_text,
+                        d_rounds,
+                        sorted_prep["num_rounds"],
+                        d_mark,
+                        ranks,
+                        d_bufs,
+                        multi,
+                        d_tpos,
+                        d_mpos,
+                        sorted_prep["maxk"],
+                        wplan["w_cap"],
+                        has_marks=has_marks,
+                        wcache_in=wc,
+                        mode=mode,
+                        group_k=group_k,
+                        has_multi=has_multi,
+                        t_act=t_act,
+                        readback=rb,
+                        span_cap=span_cap,
+                        cand_cap=cand_cap,
+                    )
+                    wcache = records.pop("wcache", None)
+                    faults.fire("device_readback")
+                    with telemetry.span("ingest.readback", readback=rb, windowed=1):
+                        if telemetry.enabled:
+                            telemetry.flow_steps(readback=rb)
+                        # One batched D2H transfer for all record planes.
+                        rec_np = jax.device_get(records)
+                    return (st, [rec_np], wcache), st.length
                 state_slices = []
                 record_chunks: List[Dict[str, np.ndarray]] = []
                 wcache_slices = []
@@ -2008,15 +2481,15 @@ class TpuUniverse:
                     faults.fire("device_launch")
                     st, records = K.merge_step_sorted_patched_batch(
                         jax.tree.map(lambda x: x[sl], self.states),
-                        jax.numpy.asarray(text_ops[sl]),
-                        jax.numpy.asarray(rounds[sl]),
+                        d_text[sl],
+                        d_rounds[sl],
                         sorted_prep["num_rounds"],
-                        jax.numpy.asarray(mark_ops[sl]),
+                        d_mark[sl],
                         ranks,
-                        jax.numpy.asarray(bufs[sl]),
+                        d_bufs[sl],
                         multi,
-                        jax.numpy.asarray(text_pos[sl]),
-                        jax.numpy.asarray(mark_pos[sl]),
+                        d_tpos[sl],
+                        d_mpos[sl],
                         sorted_prep["maxk"],
                         has_marks=has_marks,
                         wcache_in=None if wc is None else wc[sl],
@@ -2033,15 +2506,13 @@ class TpuUniverse:
                     # more than the init it saves.
                     wcache_slices.append(records.pop("wcache", None))
                     faults.fire("device_readback")
-                    # The np.asarray barrier IS the record D2H transfer —
+                    # The device_get barrier IS the record D2H transfer —
                     # span it here so the critical-path report attributes
                     # readback time separately from device dispatch.
                     with telemetry.span("ingest.readback", readback=rb, chunk=i):
                         if telemetry.enabled:
                             telemetry.flow_steps(readback=rb)
-                        record_chunks.append(
-                            {k: np.asarray(v) for k, v in records.items()}
-                        )
+                        record_chunks.append(jax.device_get(records))
                 states = (
                     state_slices[0]
                     if len(state_slices) == 1
@@ -2061,10 +2532,31 @@ class TpuUniverse:
 
             return attempt
 
+        use_window = wplan is not None
         try:
             new_states, record_chunks, wcache = self._run_launch(
-                make_attempt(readback)
+                make_attempt(readback, use_window)
             )
+            if use_window and not bool(record_chunks[0]["wok"].all()):
+                # The device census check rejected the window (stale
+                # mirror): discard the windowed result — nothing was
+                # committed — and relaunch the full-table path.  (This
+                # path's dispatch window already spans both launches, so no
+                # extra elapsed time is passed.)
+                self._window_fallback(
+                    launches=len(record_chunks),
+                    d2h_bytes=int(
+                        sum(
+                            v.nbytes
+                            for rec in record_chunks
+                            for v in rec.values()
+                        )
+                    ),
+                )
+                use_window = False
+                new_states, record_chunks, wcache = self._run_launch(
+                    make_attempt(readback)
+                )
             launches = len(record_chunks)  # successful chunk launches
             d2h = sum(v.nbytes for rec in record_chunks for v in rec.values())
             if readback == "compact" and self._span_overflow(record_chunks, span_cap):
@@ -2074,7 +2566,7 @@ class TpuUniverse:
                 # and let the grown cap cover the next batch.
                 readback = "planes"
                 new_states, record_chunks, wcache = self._run_launch(
-                    make_attempt("planes")
+                    make_attempt("planes", use_window)
                 )
                 launches += len(record_chunks)
                 d2h += sum(v.nbytes for rec in record_chunks for v in rec.values())
@@ -2086,11 +2578,26 @@ class TpuUniverse:
                 name: _strip_pos(pairs[r], with_positions)
                 for r, name in enumerate(self.replica_ids)
             }
+        if use_window and os.environ.get("PERITEXT_WINDOW_CHECK") == "1":
+            # Paranoid differential (debug/CI drill): recompute this batch
+            # on the full-table path from the same committed state and
+            # fail loudly on any plane divergence — turns a silent census
+            # bug into an immediate, batch-precise report.
+            ref_states, _, _ = self._run_launch(make_attempt(readback))
+            self._assert_states_match(ref_states, new_states, wplan, prep)
         self.states = new_states
         self.stats["launches"] += launches
+        if use_window:
+            self.stats["windowed_launches"] = (
+                self.stats.get("windowed_launches", 0) + 1
+            )
+            self._mirror_commit(wplan, record_chunks[0], prep)
         if telemetry.enabled:
             telemetry.counter("ingest.launches", launches)
             telemetry.counter("ingest.path." + mode)
+            if use_window:
+                telemetry.counter("ingest.path.windowed")
+                telemetry.flow_steps(path="windowed", window=int(wplan["w_cap"]))
             telemetry.counter("ingest.readback." + readback)
             telemetry.counter(
                 "ingest.h2d_bytes",

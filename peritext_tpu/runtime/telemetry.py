@@ -799,6 +799,8 @@ def summary() -> Dict[str, Any]:
         ("local_gen_rollbacks", "doc.local_gen_rollbacks"),
         ("blackbox_dumps", "blackbox.dumps"),
         ("blackbox_skipped", "blackbox.skipped"),
+        ("window_fallbacks", "ingest.window_fallbacks"),
+        ("window_rebuilds", "ingest.window_rebuilds"),
     ):
         if src in counters:
             out[key] = counters[src]

@@ -42,6 +42,34 @@ def patch_readback_env(mode: Optional[str] = None):
 
 
 @contextmanager
+def window_env(on: bool, min_cap: Optional[str] = None):
+    """Pin the frontier-bounded window-merge knobs for one leg.
+
+    ``on`` sets PERITEXT_MERGE_WINDOW (the windowed-vs-full A/B switch);
+    ``min_cap`` optionally pins PERITEXT_MERGE_WINDOW_MIN (tests lower it
+    so small documents engage).  Also clears the scan-forcing knobs — a
+    windowed leg measured under an ambient PERITEXT_MERGE_PATH=scan would
+    silently measure the scan path.  Restores the caller's env on exit.
+    """
+    saved = {
+        k: os.environ.get(k)
+        for k in ("PERITEXT_MERGE_WINDOW", "PERITEXT_MERGE_WINDOW_MIN")
+    }
+    os.environ["PERITEXT_MERGE_WINDOW"] = "1" if on else "0"
+    if min_cap is not None:
+        os.environ["PERITEXT_MERGE_WINDOW_MIN"] = min_cap
+    try:
+        with patch_path_env(None):
+            yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@contextmanager
 def patch_path_env(mode: Optional[str] = None):
     """Pin the patch-path selection for a measurement or differential leg.
 

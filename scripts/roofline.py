@@ -266,6 +266,37 @@ def main() -> int:
             {"group_k": 4, "t_act": 4},
         )
 
+    if want("windowed"):
+        # Frontier-bounded window merge (ISSUE 12): the same patched-delta
+        # program gathered over [R, w_cap] windows — the target whose HLO
+        # output-sum should scale with w_cap, not capacity, apart from the
+        # one gather/scatter pass over the full planes.
+        from peritext_tpu.schema import allow_multiple_array
+
+        multi = sds(allow_multiple_array(), repl)
+        tpos = sds(np.zeros(sp["text"].shape[:2], np.int32), row)
+        mpos = sds(np.zeros(batch["mark_ops"].shape[:2], np.int32), row)
+        w_cap = 256
+        iv = sds(np.zeros(R, np.int32), row)
+        windowed = jax.jit(
+            lambda st, s, h, vb, va, t, ro, m, rk, b, mu, tp, mp: (
+                K.merge_step_sorted_patched_windowed_batch(
+                    st, s, h, vb, va, t, ro, sp["num_rounds"], m, rk, b, mu,
+                    tp, mp, sp["maxk"], w_cap, mode="delta", group_k=4,
+                    t_act=4,
+                )
+            )
+        ).lower(
+            st_sds, iv, iv, iv, iv, text, rounds_sds, marks, ranks, bufs,
+            multi, tpos, mpos,
+        ).compile()
+        report(
+            "merge_step_sorted_patched_windowed @bench (w_cap=256)",
+            windowed,
+            per_chip_ops,
+            {"w_cap": w_cap},
+        )
+
     if want("patched_nomarks"):
         from peritext_tpu.schema import allow_multiple_array
 

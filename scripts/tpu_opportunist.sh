@@ -115,6 +115,14 @@ run_steps() {
   # this step is where per-shard launch CONCURRENCY becomes real.
   step config8_shards.json 3600 env CONFIG8_SHARDS=1,8 \
     python3 -m peritext_tpu.bench.configs --config 8 --platform ambient --timeout 3500 || return 1
+  probe || return 1
+  # 9. Frontier-bounded windowed merge on silicon (ISSUE 12): the
+  # windowed-vs-full single-op A/B on a 10k doc.  The CPU artifact
+  # (artifacts/window_ab_r10.jsonl) measures compute proportionality on
+  # the host backend; this step is where the O(window) launch meets real
+  # HBM and the relay's launch overhead.
+  step window_ab.jsonl 2100 env WINDOW_AB_PLATFORM=ambient \
+    python3 scripts/window_ab.py 10000 24 --out "$OUT/window_ab.jsonl" || return 1
   return 0
 }
 

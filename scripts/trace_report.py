@@ -308,6 +308,16 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
     complete = [l for l in lanes.values() if l["complete"]]
     phase_totals: Dict[str, float] = defaultdict(float)
     retried = degraded = 0
+    # Windowed-merge attribution (ISSUE 12): a lane whose ingest took the
+    # frontier-bounded window path carries a flow step stamped
+    # path=windowed (+ the window size); full-table launches don't.  The
+    # engagement fraction is judged against lanes that reached a device
+    # launch at all.
+    window_of: Dict[int, Any] = {}
+    for e in events:
+        if e.get("ph") == "t" and (e.get("args") or {}).get("path") == "windowed":
+            window_of[e["id"]] = (e.get("args") or {}).get("window")
+    windowed = launched = 0
     per_lane = []
     for lane in complete:
         bd = lane_breakdown(lane)
@@ -322,8 +332,12 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
         ]
         lane_retried = bool(attempts and max(attempts) > 0)
         lane_degraded = any(n == "ingest.degrade" for n in slice_names)
+        lane_launched = any(n == "ingest.launch_attempt" for n in slice_names)
+        lane_windowed = lane["id"] in window_of
         retried += lane_retried
         degraded += lane_degraded
+        launched += lane_launched
+        windowed += lane_windowed
         per_lane.append(
             {
                 "id": lane["id"],
@@ -333,6 +347,8 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
                 "breakdown_us": bd,
                 "retried": lane_retried,
                 "degraded": lane_degraded,
+                "windowed": lane_windowed,
+                "window": window_of.get(lane["id"]),
             }
         )
     per_lane.sort(key=lambda l: -l["total_us"])
@@ -371,6 +387,9 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
         "e2e": e2e,
         "retried_lanes": retried,
         "degraded_lanes": degraded,
+        "windowed_lanes": windowed,
+        "launched_lanes": launched,
+        "window_frac": (windowed / launched) if launched else 0.0,
         "slowest": per_lane[:top],
     }
 
@@ -391,7 +410,9 @@ def format_report(a: Dict[str, Any]) -> str:
     )
     lines.append(
         f"attribution: {a['retried_lanes']} lane(s) retried, "
-        f"{a['degraded_lanes']} degraded"
+        f"{a['degraded_lanes']} degraded, "
+        f"{a.get('windowed_lanes', 0)}/{a.get('launched_lanes', 0)} "
+        f"windowed launches"
     )
     if a.get("e2e"):
         lines.append("e2e (per terminal seam):")
@@ -421,8 +442,10 @@ def format_report(a: Dict[str, Any]) -> str:
         for l in a["slowest"]:
             bd = sorted(l["breakdown_us"].items(), key=lambda kv: -kv[1])
             bd_s = ", ".join(f"{k}={v:.0f}us" for k, v in bd if v > 0)
-            flags = ("+retry" if l["retried"] else "") + (
-                "+degraded" if l["degraded"] else ""
+            flags = (
+                ("+retry" if l["retried"] else "")
+                + ("+degraded" if l["degraded"] else "")
+                + (f"+window[{l['window']}]" if l.get("windowed") else "")
             )
             meta = f" {l['meta']}" if l["meta"] else ""
             lines.append(
@@ -444,7 +467,8 @@ def summary_line(a: Dict[str, Any]) -> str:
         f"problems={len(a['problems'])} p50_us={a['p50_us']:.0f} "
         f"p95_us={a['p95_us']:.0f} p99_us={a['p99_us']:.0f} "
         f"top_phase={top_phase}:{100 * top_us / total:.0f}% "
-        f"retried={a['retried_lanes']} degraded={a['degraded_lanes']}"
+        f"retried={a['retried_lanes']} degraded={a['degraded_lanes']} "
+        f"windowed={100 * a.get('window_frac', 0.0):.0f}%"
     )
 
 

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Windowed-vs-full-table merge A/B (ISSUE 12 acceptance leg).
+
+Runs identical edit streams through the frontier-bounded window merge
+(PERITEXT_MERGE_WINDOW=1) and the pinned full-table path
+(PERITEXT_MERGE_WINDOW=0) in ONE process:
+
+- single-op merge latency on a ``doc_len``-char document (the tracked
+  10k-doc p50 shape), patched and plain legs — byte-identity asserted via
+  the convergence digest and the emitted patch counts;
+- the config-6-shape editor-fleet steady state under CONFIG6-style edit
+  locality (the caret pattern), where ``ingest.path.windowed`` engagement
+  is the claim under test.
+
+    python scripts/window_ab.py [doc_len] [trials] [--best-of N]
+                                [--fleet-replicas N] [--locality N]
+                                [--out PATH]
+
+``--best-of`` repeats each latency leg and keeps the fastest p50 (the
+1-core build box is noisy).  Set WINDOW_AB_PLATFORM=ambient to measure on
+real hardware (default pins CPU before first backend use — the
+sitecustomize axon pin would hang on a wedged relay otherwise).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+if os.environ.get("WINDOW_AB_PLATFORM", "cpu") == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+
+    def flag(name, default, cast=int):
+        if name in argv:
+            i = argv.index(name)
+            val = cast(argv[i + 1])
+            del argv[i : i + 2]
+            return val
+        return default
+
+    best_of = flag("--best-of", 2)
+    fleet_replicas = flag("--fleet-replicas", 64)
+    locality = flag("--locality", 128)
+    out_path = flag("--out", None, cast=str)
+    args = [a for a in argv if not a.startswith("--")]
+    doc_len = int(args[0]) if len(args) > 0 else 10_000
+    trials = int(args[1]) if len(args) > 1 else 24
+
+    from peritext_tpu.bench.workloads import (
+        time_patched_fleet,
+        time_window_single_op,
+    )
+    from peritext_tpu.runtime import telemetry
+    from peritext_tpu.testing import window_env
+
+    telemetry.enable()
+
+    result = {
+        "metric": "window_ab",
+        "doc_len": doc_len,
+        "trials": trials,
+        "best_of": best_of,
+        "load_1m": round(os.getloadavg()[0], 2),
+        "started": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+    def best(windowed, patched):
+        runs = [
+            time_window_single_op(
+                doc_len=doc_len, trials=trials, windowed=windowed, patched=patched
+            )
+            for _ in range(best_of)
+        ]
+        return min(runs, key=lambda r: r["p50_ms"])
+
+    # Single-op legs (the tracked latency shape).  Byte-identity: the two
+    # legs of each pair run the same seeded edit stream, so their final
+    # convergence digests and patch counts must agree exactly.
+    for patched in (True, False):
+        leg = "patched" if patched else "plain"
+        w = best(True, patched)
+        f = best(False, patched)
+        assert w["digest"] == f["digest"], (
+            f"digest diverged on the {leg} leg: {w['digest']} != {f['digest']}"
+        )
+        assert w["patch_count"] == f["patch_count"]
+        assert w["windowed_launches"] > 0, (
+            f"windowed path never engaged on the {leg} leg: {w}"
+        )
+        assert f["windowed_launches"] == 0
+        result[f"single_{leg}_windowed_p50_ms"] = w["p50_ms"]
+        result[f"single_{leg}_full_p50_ms"] = f["p50_ms"]
+        result[f"single_{leg}_p50_cut"] = round(f["p50_ms"] / w["p50_ms"], 2)
+        result[f"single_{leg}_windowed_launches"] = w["windowed_launches"]
+        result[f"single_{leg}_window_fallbacks"] = w["window_fallbacks"]
+        print(json.dumps(result), flush=True)  # salvage point per leg pair
+
+    # Config-6-shape fleet legs under edit locality (the caret pattern):
+    # same streams per seed; engagement + warm throughput recorded.
+    fleet = {}
+    for windowed in (True, False):
+        with window_env(windowed):
+            fleet[windowed] = time_patched_fleet(
+                num_replicas=fleet_replicas, rounds=3, locality=locality
+            )
+    result["fleet_replicas"] = fleet_replicas
+    result["fleet_locality"] = locality
+    result["fleet_windowed_launches"] = fleet[True]["windowed_launches"]
+    result["fleet_window_fallbacks"] = fleet[True]["window_fallbacks"]
+    result["fleet_windowed_warm_ops_per_sec"] = round(
+        fleet[True]["patched_warm_ops_per_sec"], 1
+    )
+    result["fleet_full_warm_ops_per_sec"] = round(
+        fleet[False]["patched_warm_ops_per_sec"], 1
+    )
+    result["fleet_warm_speedup"] = round(
+        fleet[True]["patched_warm_ops_per_sec"]
+        / fleet[False]["patched_warm_ops_per_sec"],
+        3,
+    )
+    assert fleet[False]["windowed_launches"] == 0
+
+    result["load_1m_end"] = round(os.getloadavg()[0], 2)
+    line = json.dumps(result)
+    print(line)
+    if out_path:
+        with open(out_path, "a") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
