@@ -16,7 +16,7 @@ def _emit(result: dict) -> None:
     recovers the telemetry the run had accumulated by that point —
     retries, degraded batches, and merge-path tallies survive a wedged
     relay exactly like the headline number does."""
-    from peritext_tpu.runtime import health, telemetry
+    from peritext_tpu.runtime import health, slo, telemetry
 
     summary = telemetry.summary()
     # The serving-plane tallies get their own top-level stamp (admission/
@@ -26,6 +26,7 @@ def _emit(result: dict) -> None:
     if serve_summary:
         result["serve"] = serve_summary
     if summary:
+        summary.pop("slo", None)  # the dedicated block below supersedes it
         result["telemetry"] = summary
     # Health-plane summary (breaker states, trip/fastfail/canary tallies)
     # rides the same salvage contract: present on every line whenever a
@@ -33,6 +34,12 @@ def _emit(result: dict) -> None:
     health_summary = health.summary()
     if health_summary:
         result["health"] = health_summary
+    # SLO-plane verdicts (burn/compliance/breach per objective): present
+    # on every line whenever a PERITEXT_SLO plan is active, so the
+    # salvage path recovers the objective state a wedged run reached.
+    slo_summary = slo.summary()
+    if slo_summary:
+        result["slo"] = slo_summary
     print(json.dumps(result))
     sys.stdout.flush()
 

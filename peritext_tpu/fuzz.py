@@ -826,9 +826,10 @@ def _main() -> None:
 def _print_telemetry_summary() -> None:
     import json
 
-    from peritext_tpu.runtime import health, telemetry
+    from peritext_tpu.runtime import health, slo, telemetry
 
     summary = telemetry.summary()
+    summary.pop("slo", None)  # the dedicated slo: line below supersedes it
     # Causal health rides along with the tallies: the e2e latency
     # percentiles appear whenever the engine under test fed them (TpuDoc /
     # queue / pubsub seams), and the flight-recorder counts are always
@@ -847,6 +848,11 @@ def _print_telemetry_summary() -> None:
     health_summary = health.summary()
     if health_summary:
         print("health: " + json.dumps(health_summary, sort_keys=True), flush=True)
+    # SLO verdicts get their own diffable footer line whenever a
+    # PERITEXT_SLO plan was active for the run.
+    slo_summary = slo.summary()
+    if slo_summary:
+        print("slo: " + json.dumps(slo_summary, sort_keys=True), flush=True)
 
 
 if __name__ == "__main__":

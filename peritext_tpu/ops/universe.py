@@ -1018,6 +1018,7 @@ class TpuUniverse:
         if decision == health.FASTFAIL:
             self.stats["fastfails"] = self.stats.get("fastfails", 0) + 1
             if telemetry.enabled:
+                telemetry.flow_keep()  # breaker-rejected: tail-interesting
                 telemetry.record(
                     "ingest.launch",
                     flow=telemetry.current_flow(),
@@ -1070,6 +1071,10 @@ class TpuUniverse:
                         raise  # semantic error: no backend-health signal
                     if telemetry.enabled:
                         telemetry.counter("ingest.launch_failures")
+                        # A failed attempt makes every lane riding this
+                        # launch tail-interesting (retention guarantee for
+                        # sampled traces), whether or not a retry saves it.
+                        telemetry.flow_keep()
                         telemetry.record(
                             "ingest.launch",
                             flow=telemetry.current_flow(),
@@ -1536,6 +1541,10 @@ class TpuUniverse:
         batch's completion path), and a flight-recorder event marks it."""
         with telemetry.span("ingest.degrade", ingested=prep["ingested"]):
             if telemetry.enabled:
+                # A degraded batch is exactly the lane a tail-sampled
+                # production trace must never drop: mark it explicitly so
+                # retention does not hinge on arg-sniffing the seam.
+                telemetry.flow_keep()
                 telemetry.flow_steps(path="degrade")
                 telemetry.record(
                     "ingest.degrade", outcome="ok", ingested=prep["ingested"]

@@ -4,9 +4,10 @@ The host-side control plane of the framework — the equivalents of the
 reference's pubsub.ts / changeQueue.ts / test-merge.ts layer (SURVEY.md §2.4).
 The data plane (batched op application) lives in ``peritext_tpu.ops``.
 """
-from peritext_tpu.runtime import faults, health, telemetry
+from peritext_tpu.runtime import faults, health, slo, telemetry
 from peritext_tpu.runtime.faults import FaultError, FaultPlan
 from peritext_tpu.runtime.health import BreakerOpenError, CircuitBreaker, HealthPlan
+from peritext_tpu.runtime.slo import SloObjective, SloPlan
 from peritext_tpu.runtime.log import ChangeLog
 from peritext_tpu.runtime.pubsub import Publisher
 from peritext_tpu.runtime.queue import ChangeQueue, QueueFullError
@@ -44,6 +45,8 @@ __all__ = [
     "ServeShedError",
     "ShardSession",
     "ShardedServePlane",
+    "SloObjective",
+    "SloPlan",
     "Submission",
     "apply_available",
     "apply_changes",
@@ -51,6 +54,7 @@ __all__ = [
     "causal_sort",
     "faults",
     "health",
+    "slo",
     "sync_pair",
     "telemetry",
 ]

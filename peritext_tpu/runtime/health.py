@@ -243,8 +243,15 @@ class CircuitBreaker:
                 dump_info = self._trip_locked()
         if dump_info is not None:
             # Post-mortem dump outside the breaker lock (no-op unless
-            # PERITEXT_BLACKBOX is armed; names the tripped site).
-            telemetry.blackbox_dump("breaker_trip", **dump_info)
+            # PERITEXT_BLACKBOX is armed; names the tripped site).  The
+            # dedupe key is per site: a trip storm on one site writes one
+            # dump per cooldown, without suppressing another site's first
+            # trip (the ISSUE 13 shared-cooldown rule).
+            telemetry.blackbox_dump(
+                "breaker_trip",
+                dedupe_key=f"breaker_trip:{self.site}",
+                **dump_info,
+            )
 
     def abandon(self) -> None:
         """Release a canary slot without recording an outcome (the launch

@@ -405,8 +405,42 @@ class ServePlane:
             "flush_failures": 0,
         }
         self._thread: Optional[threading.Thread] = None
+        # Live ops surface (ISSUE 13): the plane contributes per-session
+        # lane depth + DWRR deficit to obs.status().  Held as a weakref —
+        # a dropped plane silently leaves the surface.
+        telemetry.register_status_source("serve", self._status)
         if start:
             self.start()
+
+    def _status(self) -> Dict[str, Any]:
+        """This plane's slice of :func:`telemetry.status`: per-session
+        admission-lane occupancy (depth in changes, lane entries, DWRR
+        deficit, priority/weight) plus the flush/miss/shed tallies an
+        operator watches, read under the plane lock."""
+        with self._lock:
+            sessions = {
+                name: {
+                    "depth": s._pending,
+                    "lane": len(s._lane),
+                    "deficit": round(s._deficit, 3),
+                    "priority": s.priority,
+                    "weight": s.weight,
+                }
+                for name, s in self._sessions.items()
+            }
+            out: Dict[str, Any] = {
+                "plane": self.name,
+                "sessions": sessions,
+                "flushes": self.stats["flushes"],
+                "deadline_misses": self.stats["deadline_misses"],
+                "deferred": self.stats["deferred"],
+                "shed": self.stats["shed"],
+                "compiled_shapes": len(self._shapes),
+                "closed": self._closed,
+            }
+            if self.shard is not None:
+                out["shard"] = self.shard
+            return out
 
     # -- sessions ------------------------------------------------------------
 

@@ -236,8 +236,48 @@ class ShardedServePlane:
         self._next_shard = 0
         # doc -> replication group: gap-tolerant log + live pubsub fan-out.
         self._docs: Dict[str, Dict[str, Any]] = {}
+        # Fleet view on the ops surface (ISSUE 13): per-shard occupancy +
+        # the compiled-shape pressure (the UNION across shards — equal
+        # widths share programs).  The per-shard ServePlanes contribute
+        # their own per-session "serve" entries.
+        telemetry.register_status_source("serve_shards", self._status)
         if telemetry.enabled:
             telemetry.gauge("serve.shards", n)
+
+    def _status(self) -> Dict[str, Any]:
+        with self._lock:
+            shards: List[Dict[str, Any]] = []
+            shapes: set = set()
+            for shard in self.shards:
+                if shard.plane is None:
+                    shards.append({"shard": shard.index, "sessions": 0})
+                    continue
+                shapes |= shard.plane.shape_keys()
+                # Per-shard pending is read under the INNER plane's lock
+                # (facade-lock -> plane-lock, the established order): a
+                # concurrent session() on that plane mutates _sessions
+                # under the plane lock, and an unlocked dict iteration
+                # here would intermittently blow up the whole status tick.
+                with shard.plane._lock:
+                    pending = sum(
+                        s._pending for s in shard.plane._sessions.values()
+                    )
+                shards.append(
+                    {
+                        "shard": shard.index,
+                        "sessions": len(shard.real),
+                        "width": len(shard.universe.replica_ids),
+                        "pads": len(shard.pad_ids),
+                        "flushes": shard.plane.stats["flushes"],
+                        "pending": pending,
+                    }
+                )
+            return {
+                "plane": self.name,
+                "shards": shards,
+                "doc_groups": len(self._docs),
+                "fleet_compiled_shapes": len(shapes),
+            }
 
     # -- shard provisioning --------------------------------------------------
 

@@ -33,9 +33,37 @@ reports through:
   events (site, flow id, outcome, µs) that is always recording while
   telemetry is enabled.  On a failure worth a post-mortem (launch-budget
   exhaustion, breaker trip, checkpoint corruption, unhandled ingest
-  exception) :func:`blackbox_dump` writes the ring + a registry snapshot
-  to ``PERITEXT_BLACKBOX=<dir>`` — the post-mortem for the wedged-relay
-  failure mode where the atexit-only dump dies with the process.
+  exception, SLO breach) :func:`blackbox_dump` writes the ring + a
+  registry snapshot to ``PERITEXT_BLACKBOX=<dir>`` — the post-mortem for
+  the wedged-relay failure mode where the atexit-only dump dies with the
+  process.  Dumps are **rate-limited per reason**
+  (``PERITEXT_BLACKBOX_COOLDOWN``, default 30s): a breach or trip storm
+  writes one dump per reason per cooldown instead of eating the 32-dump
+  cap before the interesting dump lands (skips count as
+  ``blackbox.deduped``);
+- **tail-sampled flow tracing** (``PERITEXT_TRACE_SAMPLE=<p>`` +
+  ``PERITEXT_TRACE_TAIL=slow:<ms>|error|breach``): with head sampling
+  below 1.0 the flow plane buffers each lane's events instead of writing
+  them, and decides at the terminal seam — a lane is kept when its flow
+  id head-samples in, OR (tail rules) when it was slow, touched an
+  error/retry/degrade seam, or terminated while an SLO breach was active.
+  Interesting lanes therefore survive at 100% even at ``SAMPLE=0``, which
+  is what makes an always-on production tracer affordable
+  (``trace.lanes_kept`` / ``trace.lanes_dropped`` count the verdicts;
+  span/complete events are never sampled, so kept lanes still bind);
+- an **SLO feed**: :mod:`peritext_tpu.runtime.slo` registers sink maps via
+  :func:`_install_slo_sinks`; :func:`counter` / :func:`observe` (and span
+  exits) forward matching names to the active plan's sliding-window
+  evaluators.  With no plan installed the cost is one module-attribute
+  load + ``None`` check per already-enabled call;
+- a **live status surface**: :func:`status` assembles one operator-facing
+  JSON object — breaker states, queue depth/high-water, per-session serve
+  lane depth + deficit, per-shard occupancy + fleet compiled-shape
+  pressure (via :func:`register_status_source`), windowed-merge
+  engagement, per-SLO compliance/burn, and sampler verdicts.
+  ``PERITEXT_STATUS=<path>`` writes it periodically (atomic tmp+rename,
+  riding the metrics flusher thread) and at exit; ``scripts/ops_top.py``
+  renders the file live in a terminal.
 
 Activation
 ==========
@@ -49,9 +77,14 @@ flushes that snapshot periodically from a daemon thread (atomic
 tmp+rename), so a SIGKILLed/timed-out child leaves a recent snapshot
 instead of nothing.  ``PERITEXT_BLACKBOX=<dir>`` arms the flight
 recorder's failure dumps (``PERITEXT_BLACKBOX_RING`` sizes the ring,
-default 512 events).  Any of these env vars enables collection at
-import; tests and embedders call :func:`enable` / :func:`disable` /
-:func:`reset` programmatically.
+default 512 events; ``PERITEXT_BLACKBOX_COOLDOWN`` the per-reason dump
+rate limit).  ``PERITEXT_STATUS=<path>`` arms the periodic status
+surface (cadence: ``PERITEXT_METRICS_INTERVAL``, defaulting to 2s when
+only the status path is set).  ``PERITEXT_TRACE_SAMPLE`` /
+``PERITEXT_TRACE_TAIL`` / ``PERITEXT_TRACE_SAMPLE_SEED`` configure
+flow-lane sampling (:func:`set_trace_sampling`).  Any of these env vars
+enables collection at import; tests and embedders call :func:`enable` /
+:func:`disable` / :func:`reset` programmatically.
 
 The overhead contract
 =====================
@@ -86,9 +119,11 @@ import itertools
 import json
 import math
 import os
+import random
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 # NOTE: `enabled` is deliberately NOT in __all__ — `from telemetry import
 # enabled` would snapshot the flag at import time and make guards
@@ -116,6 +151,12 @@ __all__ = [
     "current_flows",
     "current_flow",
     "flow_elapsed_s",
+    "flow_keep",
+    "set_trace_sampling",
+    "sampling_active",
+    "status",
+    "dump_status",
+    "register_status_source",
     "record",
     "recorder_events",
     "recorder_stats",
@@ -406,7 +447,14 @@ class _Span:
         # The span may outlive a disable() (e.g. a test tearing down while a
         # timer-thread flush is mid-span); record into whatever plane is
         # current — the registry/tracer never become invalid, only unused.
-        _registry.observe("span." + self.name + ".seconds", (t1 - self._t0) / 1e9)
+        secs = (t1 - self._t0) / 1e9
+        hist_name = "span." + self.name + ".seconds"
+        _registry.observe(hist_name, secs)
+        sinks = _observe_sinks
+        if sinks is not None:
+            fn = sinks.get(hist_name)
+            if fn is not None:
+                fn(secs)
         tracer = _tracer
         if tracer is not None:
             tracer.emit_complete(
@@ -441,7 +489,7 @@ class TraceContext:
     cannot corrupt the triplet.
     """
 
-    __slots__ = ("id", "kind", "t0_ns", "meta", "_phase")
+    __slots__ = ("id", "kind", "t0_ns", "meta", "_phase", "_keep")
 
     def __init__(self, kind: str, meta: Optional[Dict[str, Any]] = None) -> None:
         self.id = next(_flow_ids)
@@ -449,6 +497,7 @@ class TraceContext:
         self.t0_ns = time.perf_counter_ns()
         self.meta = meta
         self._phase = 0  # 0 unstarted, 1 started, 2 finished
+        self._keep = False  # explicit tail-keep mark (flow_keep)
 
 
 class _Flowing:
@@ -524,10 +573,12 @@ class _FlightRecorder:
 
 
 class _MetricsFlusher(threading.Thread):
-    """Periodic metrics-snapshot flush (PERITEXT_METRICS_INTERVAL): the
-    atexit dump dies exactly when it matters most (SIGKILLed bench child,
-    wedged-relay timeout); this daemon leaves a recent atomic snapshot
-    behind instead."""
+    """Periodic metrics-snapshot + status flush (PERITEXT_METRICS_INTERVAL
+    / PERITEXT_STATUS): the atexit dump dies exactly when it matters most
+    (SIGKILLed bench child, wedged-relay timeout); this daemon leaves a
+    recent atomic snapshot — and the live ops status surface — behind
+    instead.  Each tick writes whichever of the metrics/status paths are
+    configured."""
 
     def __init__(self, interval: float) -> None:
         super().__init__(daemon=True, name="peritext-metrics-flusher")
@@ -544,6 +595,14 @@ class _MetricsFlusher(threading.Thread):
                 logging.getLogger(__name__).warning(
                     "periodic metrics flush failed", exc_info=True
                 )
+            try:
+                dump_status()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "periodic status flush failed", exc_info=True
+                )
 
 
 # -- the process-wide plane ---------------------------------------------------
@@ -558,12 +617,51 @@ _blackbox_dir: Optional[str] = None
 _blackbox_seq = itertools.count(1)
 _MAX_BLACKBOX_DUMPS = 32
 _flusher: Optional[_MetricsFlusher] = None
+# Per-reason black-box dump rate limiting (satellite of ISSUE 13): one
+# dump per dedupe key per cooldown, so a breach/trip storm cannot exhaust
+# the 32-dump cap before the interesting dump.  Keyed by reason (or an
+# explicit dedupe key), judged on time.monotonic.
+_dump_last: Dict[str, float] = {}
+_DUMP_COOLDOWN_DEFAULT = 30.0
+
+# -- tail-sampled tracing state ----------------------------------------------
+# Head-sampling probability per flow lane (1.0 = emit everything directly,
+# the historical behavior).  Below 1.0 the flow plane buffers lanes in
+# _lane_buf and judges them at the terminal seam against the tail rules.
+_sample_p = 1.0
+_sample_seed = 0
+_tail_slow_us: Optional[float] = None  # keep lanes slower than this
+_tail_error = False  # keep lanes that touched an error/retry/degrade seam
+_tail_breach = False  # keep lanes terminating while an SLO breach is active
+_breach_probe: Optional[Callable[[], bool]] = None  # set by the SLO plane
+# flow id -> [buffered emit_flow arg tuples, interesting flag].  Bounded:
+# past _LANE_BUF_CAP open lanes the oldest is evicted (trace.lanes_evicted).
+_lane_buf: Dict[int, List[Any]] = {}
+_LANE_BUF_CAP = 4096
+
+# -- SLO feed sinks -----------------------------------------------------------
+# Installed by peritext_tpu.runtime.slo: metric-name -> feed callable.
+# None (the common case) costs one module-attribute load per enabled call.
+_observe_sinks: Optional[Dict[str, Callable[[float], None]]] = None
+_counter_sinks: Optional[Dict[str, Callable[[int], None]]] = None
+
+# -- status surface -----------------------------------------------------------
+_status_path: Optional[str] = None
+# (kind, WeakMethod) pairs registered by live planes (serve, serve_shard);
+# dead refs are pruned on read.
+_status_sources: List[Tuple[str, Any]] = []
 
 
 def counter(name: str, n: int = 1) -> None:
-    """Add ``n`` to a monotonic counter (no-op while disabled)."""
+    """Add ``n`` to a monotonic counter (no-op while disabled).  With an
+    SLO plan installed, names it watches also feed its evaluators."""
     if enabled:
         _registry.counter(name, n)
+        sinks = _counter_sinks
+        if sinks is not None:
+            fn = sinks.get(name)
+            if fn is not None:
+                fn(n)
 
 
 def gauge(name: str, value: float) -> None:
@@ -579,9 +677,16 @@ def gauge_max(name: str, value: float) -> None:
 
 
 def observe(name: str, value: float) -> None:
-    """Record a value into a log2-bucket histogram (no-op while disabled)."""
+    """Record a value into a log2-bucket histogram (no-op while disabled).
+    With an SLO plan installed, names it watches also feed its
+    evaluators."""
     if enabled:
         _registry.observe(name, value)
+        sinks = _observe_sinks
+        if sinks is not None:
+            fn = sinks.get(name)
+            if fn is not None:
+                fn(value)
 
 
 def span(name: str, **args: Any) -> Any:
@@ -602,6 +707,51 @@ def flow(kind: str, **meta: Any) -> Optional[TraceContext]:
     return TraceContext(kind, meta or None)
 
 
+# Terminal/step args that mark a lane tail-interesting: failed or rejected
+# outcomes, the oracle-degrade seam, and retry attempts (attempt >= 1).
+# Every terminal failure outcome any seam emits belongs here — a missed
+# spelling silently drops exactly the lanes a post-mortem needs (the
+# emitters: serve resolve/shed/close, ingest launch/record, TpuDoc local
+# rollback, stream sweep abort, queue drop).
+_TAIL_BAD_OUTCOMES = frozenset(
+    (
+        "error",
+        "rejected",
+        "shed",
+        "closed",
+        "fail",
+        "fastfail",
+        "degraded",
+        "rollback",
+        "abort",
+        "dropped",
+    )
+)
+
+
+def _args_interesting(args: Optional[Dict[str, Any]]) -> bool:
+    if not args:
+        return False
+    if args.get("outcome") in _TAIL_BAD_OUTCOMES:
+        return True
+    if args.get("path") == "degrade":
+        return True
+    attempt = args.get("attempt")
+    return isinstance(attempt, int) and attempt >= 1
+
+
+def _head_sampled(flow_id: int) -> bool:
+    """Deterministic head-sampling verdict for one lane: a seeded hash of
+    the flow id (mint order is deterministic under seeded chaos, so the
+    same run keeps the same lanes)."""
+    p = _sample_p
+    if p >= 1.0:
+        return True
+    if p <= 0.0:
+        return False
+    return random.Random(f"{_sample_seed}/{flow_id}").random() < p
+
+
 def flow_point(
     ctx: Optional[TraceContext], terminal: bool = False, **args: Any
 ) -> None:
@@ -611,7 +761,13 @@ def flow_point(
     the slice covering their timestamp on this thread.  The first point
     emits the flow start (``s``), later ones steps (``t``), and
     ``terminal=True`` the finish (``f``); points after a finish are
-    dropped, so retried seams cannot emit a second finish."""
+    dropped, so retried seams cannot emit a second finish.
+
+    With head sampling below 1.0 (:func:`set_trace_sampling`) the lane's
+    events buffer instead, and the terminal point decides: head-sampled
+    in, or retained by a tail rule (slow / error / breach-coincident), the
+    whole lane flushes to the tracer; otherwise it drops
+    (``trace.lanes_kept`` / ``trace.lanes_dropped``)."""
     if ctx is None:
         return
     tracer = _tracer
@@ -625,12 +781,136 @@ def flow_point(
         start = phase0 == 0
         ctx._phase = 2 if terminal else 1
     tid = threading.get_ident()
+    events: List[Tuple[Any, ...]] = []
     if start:
-        tracer.emit_flow(ctx.kind, "s", ctx.id, now_us, tid, ctx.meta)
+        events.append((ctx.kind, "s", ctx.id, now_us, tid, ctx.meta))
     if terminal:
-        tracer.emit_flow(ctx.kind, "f", ctx.id, now_us, tid, args or None)
+        events.append((ctx.kind, "f", ctx.id, now_us, tid, args or None))
     elif not start:
-        tracer.emit_flow(ctx.kind, "t", ctx.id, now_us, tid, args or None)
+        events.append((ctx.kind, "t", ctx.id, now_us, tid, args or None))
+    # Direct emission (the historical path) unless sampling is on.  The
+    # `_lane_buf` check keeps a lane that STARTED buffered coherent if
+    # sampling is reconfigured mid-lane: its remaining points keep
+    # buffering, and the terminal verdict (p=1 head-samples everything in)
+    # emits the whole lane.
+    if _sample_p >= 1.0 and not _lane_buf:
+        for ev in events:
+            tracer.emit_flow(*ev)
+        return
+    _buffer_flow(tracer, ctx, events, args or None, terminal)
+
+
+def _buffer_flow(
+    tracer: "_Tracer",
+    ctx: TraceContext,
+    events: List[Tuple[Any, ...]],
+    args: Optional[Dict[str, Any]],
+    terminal: bool,
+) -> None:
+    keep = None
+    with _flow_lock:
+        buf = _lane_buf.get(ctx.id)
+        if buf is None:
+            if len(_lane_buf) >= _LANE_BUF_CAP:
+                # Evict the oldest still-open lane (insertion order): a
+                # leak of never-terminated lanes must not grow unbounded.
+                oldest = next(iter(_lane_buf))
+                del _lane_buf[oldest]
+                if enabled:
+                    _registry.counter("trace.lanes_evicted")
+            buf = _lane_buf[ctx.id] = [events, False]
+        else:
+            buf[0].extend(events)
+        if args is not None and _args_interesting(args):
+            buf[1] = True
+        if not terminal:
+            return
+        lane_events, interesting = _lane_buf.pop(ctx.id)
+        interesting = interesting or ctx._keep
+        keep = _head_sampled(ctx.id)
+        if not keep and _tail_error and interesting:
+            keep = True
+        if not keep and _tail_slow_us is not None:
+            keep = (time.perf_counter_ns() - ctx.t0_ns) / 1e3 >= _tail_slow_us
+        if not keep and _tail_breach:
+            probe = _breach_probe
+            if probe is not None:
+                try:
+                    keep = bool(probe())
+                except Exception:
+                    keep = True  # a broken probe must not drop evidence
+    # Emission + counters outside _flow_lock (the tracer has its own lock).
+    if keep:
+        for ev in lane_events:
+            tracer.emit_flow(*ev)
+    if enabled:
+        _registry.counter("trace.lanes_kept" if keep else "trace.lanes_dropped")
+
+
+def flow_keep(ctx: Optional[TraceContext] = None) -> None:
+    """Explicitly mark a lane (default: every lane scoped onto this
+    thread) as tail-interesting, guaranteeing retention under tail
+    sampling's ``error`` rule regardless of what args its seams carried.
+    The degrade/fast-fail seams call this so a sampled production trace
+    can never lose a failed lane.  No-op while disabled."""
+    if not enabled:
+        return
+    if ctx is not None:
+        ctx._keep = True
+        return
+    for c in getattr(_tls, "flows", ()):
+        c._keep = True
+
+
+def set_trace_sampling(
+    sample: Optional[float] = None,
+    tail: Optional[str] = None,
+    seed: Optional[int] = None,
+) -> None:
+    """Configure flow-lane sampling (``PERITEXT_TRACE_SAMPLE`` /
+    ``PERITEXT_TRACE_TAIL`` / ``PERITEXT_TRACE_SAMPLE_SEED``).
+
+    ``sample`` is the head-sampling probability per lane, clamped to
+    [0, 1]; 1.0 restores direct emission.  ``tail`` is a ``|``-separated
+    rule list — ``slow:<ms>`` (keep lanes at least that slow), ``error``
+    (keep lanes that touched an error/retry/degrade seam or were
+    :func:`flow_keep`-marked), ``breach`` (keep lanes terminating while an
+    SLO breach is active); the empty string clears the rules.  Unknown
+    rules raise ValueError (a typo'd spec must not silently sample
+    everything away)."""
+    global _sample_p, _sample_seed, _tail_slow_us, _tail_error, _tail_breach
+    with _config_lock:
+        if sample is not None:
+            _sample_p = min(1.0, max(0.0, float(sample)))
+        if seed is not None:
+            _sample_seed = int(seed)
+        if tail is not None:
+            slow_us: Optional[float] = None
+            error = breach = False
+            for term in tail.split("|"):
+                term = term.strip()
+                if not term:
+                    continue
+                if term.startswith("slow:"):
+                    slow_us = float(term[5:]) * 1e3
+                elif term == "error":
+                    error = True
+                elif term == "breach":
+                    breach = True
+                else:
+                    raise ValueError(
+                        f"unknown trace tail rule {term!r} "
+                        "(want slow:<ms> | error | breach)"
+                    )
+            _tail_slow_us = slow_us
+            _tail_error = error
+            _tail_breach = breach
+
+
+def sampling_active() -> bool:
+    """True when flow lanes are being buffered and judged (head sampling
+    below 1.0), False in direct-emission mode."""
+    return _sample_p < 1.0
 
 
 def flow_steps(terminal: bool = False, **args: Any) -> None:
@@ -719,17 +999,50 @@ def blackbox_dir() -> Optional[str]:
     return _blackbox_dir
 
 
-def blackbox_dump(reason: str, **info: Any) -> Optional[str]:
+def blackbox_dump(
+    reason: str,
+    dedupe_key: Optional[str] = None,
+    dedupe_cooldown_s: Optional[float] = None,
+    **info: Any,
+) -> Optional[str]:
     """Write a post-mortem dump (ring + registry snapshot + summary) to the
     ``PERITEXT_BLACKBOX`` directory; returns the path or None when unarmed.
 
     Atomic (tmp+rename), monotonic per-process sequence numbers, and capped
     at a few dozen dumps per process so a wedge storm cannot fill the disk
-    (skips count as ``blackbox.skipped``).  Never raises — a full disk must
-    not turn a post-mortem into a second failure."""
+    (skips count as ``blackbox.skipped``).  Additionally rate-limited per
+    reason: within ``dedupe_cooldown_s`` (default
+    ``PERITEXT_BLACKBOX_COOLDOWN``, 30s) of the previous dump for the same
+    ``dedupe_key`` (default: the reason), the dump is skipped and counted
+    as ``blackbox.deduped`` — a trip/breach storm writes its first dump,
+    not 32 copies of it.  Callers that rate-limit themselves (the SLO
+    plane, on its injectable clock) pass ``dedupe_cooldown_s=0`` to bypass
+    the wall-clock limiter.  Never raises
+    — a full disk must not turn a post-mortem into a second failure."""
     d = _blackbox_dir
     if d is None:
         return None
+    if dedupe_cooldown_s is None:
+        try:
+            dedupe_cooldown_s = float(
+                os.environ.get("PERITEXT_BLACKBOX_COOLDOWN", "")
+                or _DUMP_COOLDOWN_DEFAULT
+            )
+        except ValueError:
+            dedupe_cooldown_s = _DUMP_COOLDOWN_DEFAULT
+    key = dedupe_key or reason
+    now = time.monotonic()
+    with _config_lock:
+        last = _dump_last.get(key)
+        if (
+            last is not None
+            and dedupe_cooldown_s > 0
+            and now - last < dedupe_cooldown_s
+        ):
+            if enabled:
+                _registry.counter("blackbox.deduped")
+            return None
+        _dump_last[key] = now
     seq = next(_blackbox_seq)
     if seq > _MAX_BLACKBOX_DUMPS:
         if enabled:
@@ -801,6 +1114,10 @@ def summary() -> Dict[str, Any]:
         ("blackbox_skipped", "blackbox.skipped"),
         ("window_fallbacks", "ingest.window_fallbacks"),
         ("window_rebuilds", "ingest.window_rebuilds"),
+        ("blackbox_deduped", "blackbox.deduped"),
+        ("trace_lanes_kept", "trace.lanes_kept"),
+        ("trace_lanes_dropped", "trace.lanes_dropped"),
+        ("trace_lanes_evicted", "trace.lanes_evicted"),
     ):
         if src in counters:
             out[key] = counters[src]
@@ -829,6 +1146,19 @@ def summary() -> Dict[str, Any]:
     }
     if health_mirror:
         out["health"] = health_mirror
+    # SLO-plane mirror (runtime/slo.py): breach counters plus the live
+    # burn/compliance/breached gauges, so a bench stamp or chaos footer
+    # carries the objective verdicts without a separate plumbing path.
+    slo_mirror: Dict[str, Any] = {
+        name[len("slo.") :]: n
+        for name, n in counters.items()
+        if name.startswith("slo.")
+    }
+    for name, v in gauges.items():
+        if name.startswith("slo."):
+            slo_mirror[name[len("slo.") :]] = v
+    if slo_mirror:
+        out["slo"] = slo_mirror
     # Serving-plane tallies (runtime/serve.py): present whenever serve
     # traffic happened, so bench JSON stamps and the fuzz --chaos footer
     # carry admission/batching/shed behavior without a separate plumbing
@@ -874,6 +1204,168 @@ def summary() -> Dict[str, Any]:
     return out
 
 
+def _install_slo_sinks(
+    observe_map: Optional[Dict[str, Callable[[float], None]]],
+    counter_map: Optional[Dict[str, Callable[[int], None]]],
+    breach_probe: Optional[Callable[[], bool]],
+) -> None:
+    """Wire (or clear, with Nones) the SLO plane's feed maps and breach
+    probe.  Called by :mod:`peritext_tpu.runtime.slo` on install/reset —
+    not a public API."""
+    global _observe_sinks, _counter_sinks, _breach_probe
+    with _config_lock:
+        _observe_sinks = observe_map or None
+        _counter_sinks = counter_map or None
+        _breach_probe = breach_probe
+
+
+def register_status_source(kind: str, method: Any) -> None:
+    """Register a live plane's status contributor (a *bound method*
+    returning a JSON-able dict; held as a weakref, so a dropped plane
+    silently leaves the surface).  ``kind`` groups the payload in
+    :func:`status` — the serving planes register ``"serve"`` /
+    ``"serve_shards"``."""
+    ref = weakref.WeakMethod(method)
+    with _config_lock:
+        # Opportunistic prune: long test sessions mint many short-lived
+        # planes; dead refs must not accumulate.
+        _status_sources[:] = [(k, r) for k, r in _status_sources if r() is not None]
+        _status_sources.append((kind, ref))
+
+
+def status() -> Dict[str, Any]:
+    """One operator-facing snapshot of the live process: breaker states,
+    queue pressure, serving-plane occupancy (per-session lane depth +
+    deficit, per-shard width/occupancy + fleet compiled-shape pressure),
+    windowed-merge engagement, per-SLO compliance/burn, e2e latency
+    quantiles, and the trace sampler's verdict counts.  Built entirely
+    from already-collected state — calling it never perturbs the planes
+    it reports on.  ``PERITEXT_STATUS=<path>`` writes it periodically
+    (and at exit); ``scripts/ops_top.py`` renders it."""
+    snap = _registry.snapshot()
+    counters, gauges = snap["counters"], snap["gauges"]
+    out: Dict[str, Any] = {
+        "pid": os.getpid(),
+        "time": time.time(),
+        "enabled": enabled,
+    }
+    # Health plane: breaker state + tallies per site.  Late import — the
+    # health module imports this one, so the dependency must stay one-way
+    # at import time.
+    try:
+        from peritext_tpu.runtime import health as _health
+
+        breakers = _health.summary()
+    except Exception:
+        breakers = {}
+    if breakers:
+        out["breakers"] = breakers
+    queue_block: Dict[str, Any] = {}
+    for label, src in (
+        ("flushes", "queue.flushes"),
+        ("reenqueues", "queue.reenqueues"),
+        ("shed", "queue.shed"),
+        ("coalesced", "queue.coalesced"),
+        ("blocked", "queue.blocked"),
+    ):
+        if src in counters:
+            queue_block[label] = counters[src]
+    if "queue.depth_max" in gauges:
+        queue_block["depth_max"] = gauges["queue.depth_max"]
+    if queue_block:
+        out["queue"] = queue_block
+    launches = counters.get("ingest.launches", 0)
+    if launches:
+        windowed = counters.get("ingest.path.windowed", 0)
+        out["ingest"] = {
+            "launches": launches,
+            "degraded_batches": counters.get("ingest.degraded_batches", 0),
+            "launch_failures": counters.get("ingest.launch_failures", 0),
+            "fastfails": counters.get("health.fastfail", 0),
+            "windowed_launches": windowed,
+            "window_engagement_pct": round(100.0 * windowed / launches, 1),
+            "window_fallbacks": counters.get("ingest.window_fallbacks", 0),
+        }
+    # Live plane contributors (serve / serve_shard status sources).
+    with _config_lock:
+        sources = list(_status_sources)
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for kind, ref in sources:
+        method = ref()
+        if method is None:
+            continue
+        try:
+            payload = method()
+        except Exception:  # a closing plane must not break the surface
+            continue
+        if payload:
+            by_kind.setdefault(kind, []).append(payload)
+    for kind, payloads in by_kind.items():
+        out[kind] = payloads
+    # SLO plane verdicts (late import, same one-way rationale as health).
+    try:
+        from peritext_tpu.runtime import slo as _slo
+
+        slo_summary = _slo.summary()
+    except Exception:
+        slo_summary = {}
+    if slo_summary:
+        out["slo"] = slo_summary
+    e2e = {}
+    for name, h in snap["histograms"].items():
+        if name.startswith("e2e."):
+            q = estimate_quantiles(h)
+            if q is not None:
+                q["count"] = h["count"]
+                e2e[name[len("e2e.") :]] = q
+    if e2e:
+        out["e2e"] = e2e
+    trace_block: Dict[str, Any] = {}
+    if _tracer is not None:
+        trace_block["path"] = _tracer.path
+    if sampling_active():
+        trace_block["sample"] = _sample_p
+        trace_block["tail"] = {
+            "slow_ms": None if _tail_slow_us is None else _tail_slow_us / 1e3,
+            "error": _tail_error,
+            "breach": _tail_breach,
+        }
+        with _flow_lock:
+            trace_block["open_lanes"] = len(_lane_buf)
+    for label, src in (
+        ("lanes_kept", "trace.lanes_kept"),
+        ("lanes_dropped", "trace.lanes_dropped"),
+        ("lanes_evicted", "trace.lanes_evicted"),
+    ):
+        if src in counters:
+            trace_block[label] = counters[src]
+    if trace_block:
+        out["trace"] = trace_block
+    for label, src in (
+        ("blackbox_dumps", "blackbox.dumps"),
+        ("blackbox_deduped", "blackbox.deduped"),
+    ):
+        if src in counters:
+            out[label] = counters[src]
+    return out
+
+
+def dump_status(path: Optional[str] = None) -> Optional[str]:
+    """Write :func:`status` as JSON, atomically (tmp+rename, per-writer tmp
+    names — same discipline as :func:`dump_metrics`).  Defaults to the
+    ``PERITEXT_STATUS`` path; returns the path written or None."""
+    path = path or _status_path
+    if not path:
+        return None
+    payload = status()
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with _dump_lock:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    return path
+
+
 def trace_path() -> Optional[str]:
     """Path of the active trace file, or None when not tracing."""
     tracer = _tracer
@@ -885,13 +1377,16 @@ def enable(
     metrics: Optional[str] = None,
     blackbox: Optional[str] = None,
     metrics_interval: Optional[float] = None,
+    status_path: Optional[str] = None,
 ) -> None:
     """Turn collection on.  ``trace`` opens (truncating) a Chrome trace
     JSONL file; ``metrics`` schedules a snapshot dump at interpreter exit
     (``metrics_interval`` > 0 additionally flushes it periodically from a
-    daemon thread); ``blackbox`` arms failure dumps to a directory.  All
-    may be omitted — a bare ``enable()`` collects registry metrics only."""
-    global enabled, _tracer, _metrics_path, _blackbox_dir, _flusher
+    daemon thread); ``blackbox`` arms failure dumps to a directory;
+    ``status_path`` arms the live ops status surface (written on the same
+    periodic flusher and at exit).  All may be omitted — a bare
+    ``enable()`` collects registry metrics only."""
+    global enabled, _tracer, _metrics_path, _blackbox_dir, _flusher, _status_path
     with _config_lock:
         if trace:
             if _tracer is not None and _tracer.path != trace:
@@ -903,9 +1398,15 @@ def enable(
             _metrics_path = metrics
         if blackbox:
             _blackbox_dir = blackbox
+        if status_path:
+            _status_path = status_path
         _ensure_atexit_locked()
         enabled = True
-        if metrics_interval and metrics_interval > 0 and _metrics_path:
+        if (
+            metrics_interval
+            and metrics_interval > 0
+            and (_metrics_path or _status_path)
+        ):
             if _flusher is not None and _flusher.interval != metrics_interval:
                 _flusher.stop_event.set()
                 _flusher = None
@@ -924,9 +1425,12 @@ def disable() -> None:
 def reset() -> None:
     """Back to a pristine, disabled plane: counters cleared, tracer closed,
     exit dump canceled, recorder ring dropped, black-box disarmed, the
-    periodic flusher stopped.  Does NOT re-read the environment (tests own
-    the lifecycle after a reset)."""
+    periodic flusher stopped, sampling back to direct emission, SLO sinks
+    and status sources cleared.  Does NOT re-read the environment (tests
+    own the lifecycle after a reset)."""
     global enabled, _tracer, _metrics_path, _recorder, _blackbox_dir, _flusher
+    global _sample_p, _sample_seed, _tail_slow_us, _tail_error, _tail_breach
+    global _breach_probe, _observe_sinks, _counter_sinks, _status_path
     with _config_lock:
         enabled = False
         if _tracer is not None:
@@ -938,7 +1442,19 @@ def reset() -> None:
         if _flusher is not None:
             _flusher.stop_event.set()
             _flusher = None
+        _sample_p = 1.0
+        _sample_seed = 0
+        _tail_slow_us = None
+        _tail_error = _tail_breach = False
+        _breach_probe = None
+        _observe_sinks = None
+        _counter_sinks = None
+        _status_path = None
+        _status_sources.clear()
+        _dump_last.clear()
         _registry.clear()
+    with _flow_lock:
+        _lane_buf.clear()
 
 
 def flush_trace() -> None:
@@ -975,8 +1491,12 @@ def dump_metrics(path: Optional[str] = None) -> Optional[str]:
 
 def _at_exit() -> None:
     try:
-        if _metrics_path:
-            dump_metrics(_metrics_path)
+        try:
+            if _metrics_path:
+                dump_metrics(_metrics_path)
+        finally:
+            if _status_path:
+                dump_status(_status_path)
     finally:
         tracer = _tracer
         if tracer is not None:
@@ -992,7 +1512,8 @@ def _ensure_atexit_locked() -> None:
 
 def _activate_from_env() -> None:
     """Import-time activation from PERITEXT_TRACE / PERITEXT_METRICS /
-    PERITEXT_BLACKBOX (+ PERITEXT_METRICS_INTERVAL).
+    PERITEXT_BLACKBOX / PERITEXT_STATUS (+ PERITEXT_METRICS_INTERVAL and
+    the PERITEXT_TRACE_SAMPLE / PERITEXT_TRACE_TAIL sampler knobs).
 
     A bad trace path (missing directory, permissions) must not take the
     whole product down at import — observability degrades to untraced
@@ -1001,11 +1522,32 @@ def _activate_from_env() -> None:
     trace = os.environ.get("PERITEXT_TRACE")
     metrics = os.environ.get("PERITEXT_METRICS")
     blackbox = os.environ.get("PERITEXT_BLACKBOX")
+    status_p = os.environ.get("PERITEXT_STATUS")
     try:
         interval = float(os.environ.get("PERITEXT_METRICS_INTERVAL", "0") or 0)
     except ValueError:
         interval = 0.0
-    if not (trace or metrics or blackbox):
+    if status_p and not interval:
+        # The status surface is only useful live; give it a cadence even
+        # when the metrics snapshot doesn't ask for one.
+        interval = 2.0
+    sample = os.environ.get("PERITEXT_TRACE_SAMPLE")
+    tail = os.environ.get("PERITEXT_TRACE_TAIL")
+    seed = os.environ.get("PERITEXT_TRACE_SAMPLE_SEED")
+    if sample or tail or seed:
+        try:
+            set_trace_sampling(
+                sample=float(sample) if sample else None,
+                tail=tail if tail is not None else None,
+                seed=int(seed) if seed else None,
+            )
+        except ValueError as exc:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "trace sampling env unusable (%s); sampling stays off", exc
+            )
+    if not (trace or metrics or blackbox or status_p):
         return
     try:
         enable(
@@ -1013,6 +1555,7 @@ def _activate_from_env() -> None:
             metrics=metrics or None,
             blackbox=blackbox or None,
             metrics_interval=interval or None,
+            status_path=status_p or None,
         )
     except OSError as exc:
         import logging
@@ -1026,6 +1569,7 @@ def _activate_from_env() -> None:
             metrics=metrics or None,
             blackbox=blackbox or None,
             metrics_interval=interval or None,
+            status_path=status_p or None,
         )
 
 

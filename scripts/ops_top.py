@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Live ops status: render the PERITEXT_STATUS JSON surface in a terminal.
+
+The serving process (or any process with ``PERITEXT_STATUS=<path>`` set)
+writes an atomic status snapshot periodically — breaker states, queue
+pressure, per-session serve lane depth + deficit, per-shard occupancy,
+windowed-merge engagement, per-SLO compliance/burn, trace-sampler
+verdicts.  This script tails that file and redraws, top(1)-style; CI and
+scripts use ``--once`` for a single render (exit 1 when the file is
+missing or unparseable, so a smoke step fails loudly).
+
+Usage:
+    python scripts/ops_top.py /tmp/peritext_status.json [--interval 2]
+                              [--once] [--json]
+
+Stdlib-only: runs anywhere the JSON lands, no JAX needed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+
+def load_status(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt_quantiles(q: Dict[str, Any]) -> str:
+    parts = []
+    for key in ("p50", "p95", "p99"):
+        if key in q:
+            parts.append(f"{key} {q[key] * 1000:.1f}ms")
+    if "count" in q:
+        parts.append(f"n={q['count']}")
+    return "  ".join(parts)
+
+
+def render(status: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    age = time.time() - status.get("time", 0.0)
+    lines.append(
+        f"peritext ops — pid {status.get('pid', '?')}  "
+        f"snapshot age {age:.1f}s  "
+        f"telemetry {'on' if status.get('enabled') else 'off'}"
+    )
+    slo = status.get("slo") or {}
+    if slo:
+        lines.append("slo:")
+        for name, s in sorted(slo.items()):
+            flag = "BREACHED" if s.get("breached") else "ok"
+            lines.append(
+                f"  {name:<28} {flag:<9} burn {s.get('burn', 0):>7.2f}  "
+                f"compliance {100 * s.get('compliance', 1.0):6.2f}%  "
+                f"events {s.get('events', 0):<7} breaches {s.get('breaches', 0)}"
+            )
+    breakers = status.get("breakers") or {}
+    if breakers:
+        lines.append("breakers:")
+        for site, b in sorted(breakers.items()):
+            lines.append(
+                f"  {site:<28} {b.get('state', '?'):<9} "
+                f"trips {b.get('trips', 0):<4} fastfails {b.get('fastfails', 0):<6} "
+                f"failures {b.get('failures', 0)}"
+            )
+    ingest = status.get("ingest") or {}
+    if ingest:
+        lines.append(
+            f"ingest: launches {ingest.get('launches', 0)}  "
+            f"windowed {ingest.get('window_engagement_pct', 0):.1f}%  "
+            f"degraded {ingest.get('degraded_batches', 0)}  "
+            f"failures {ingest.get('launch_failures', 0)}  "
+            f"fastfails {ingest.get('fastfails', 0)}"
+        )
+    queue = status.get("queue") or {}
+    if queue:
+        lines.append(
+            f"queue:  depth_max {queue.get('depth_max', 0)}  "
+            f"flushes {queue.get('flushes', 0)}  "
+            f"reenqueues {queue.get('reenqueues', 0)}  "
+            f"shed {queue.get('shed', 0)}"
+        )
+    for fleet in status.get("serve_shards") or []:
+        lines.append(
+            f"serve fleet {fleet.get('plane')}: "
+            f"{len(fleet.get('shards', []))} shard(s)  "
+            f"doc groups {fleet.get('doc_groups', 0)}  "
+            f"fleet compiled shapes {fleet.get('fleet_compiled_shapes', 0)}"
+        )
+        for sh in fleet.get("shards", []):
+            lines.append(
+                f"  shard {sh.get('shard'):<3} sessions {sh.get('sessions', 0):<4} "
+                f"width {sh.get('width', 0):<4} pads {sh.get('pads', 0):<4} "
+                f"pending {sh.get('pending', 0):<5} flushes {sh.get('flushes', 0)}"
+            )
+    for plane in status.get("serve") or []:
+        closed = " (closed)" if plane.get("closed") else ""
+        lines.append(
+            f"serve plane {plane.get('plane')}{closed}: "
+            f"flushes {plane.get('flushes', 0)}  "
+            f"deadline misses {plane.get('deadline_misses', 0)}  "
+            f"shed {plane.get('shed', 0)}  "
+            f"shapes {plane.get('compiled_shapes', 0)}"
+        )
+        sessions = plane.get("sessions") or {}
+        for name, s in sorted(sessions.items()):
+            lines.append(
+                f"  {name:<20} depth {s.get('depth', 0):<5} "
+                f"lane {s.get('lane', 0):<4} deficit {s.get('deficit', 0):<8} "
+                f"{s.get('priority', '')}/{s.get('weight', 1)}"
+            )
+    e2e = status.get("e2e") or {}
+    if e2e:
+        lines.append("e2e:")
+        for name, q in sorted(e2e.items()):
+            lines.append(f"  {name:<28} {_fmt_quantiles(q)}")
+    trace = status.get("trace") or {}
+    if trace:
+        sample = trace.get("sample")
+        bits = [f"kept {trace.get('lanes_kept', 0)}",
+                f"dropped {trace.get('lanes_dropped', 0)}"]
+        if sample is not None:
+            bits.append(f"head p={sample:g}")
+            tail = trace.get("tail") or {}
+            rules = [
+                r
+                for r, on in (
+                    (f"slow:{tail.get('slow_ms')}ms", tail.get("slow_ms") is not None),
+                    ("error", tail.get("error")),
+                    ("breach", tail.get("breach")),
+                )
+                if on
+            ]
+            if rules:
+                bits.append("tail " + "|".join(rules))
+            bits.append(f"open lanes {trace.get('open_lanes', 0)}")
+        lines.append("trace:  " + "  ".join(bits))
+    dumps = status.get("blackbox_dumps")
+    if dumps is not None:
+        lines.append(
+            f"blackbox: {dumps} dump(s), "
+            f"{status.get('blackbox_deduped', 0)} deduped"
+        )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("status", help="PERITEXT_STATUS JSON path")
+    parser.add_argument(
+        "--interval", type=float, default=2.0, help="redraw period (seconds)"
+    )
+    parser.add_argument(
+        "--once", action="store_true", help="render once and exit (CI smoke)"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the raw JSON instead"
+    )
+    args = parser.parse_args()
+    while True:
+        try:
+            status = load_status(args.status)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"ops_top: cannot read {args.status}: {exc}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.json:
+            print(json.dumps(status, indent=2, sort_keys=True))
+        else:
+            if not args.once:
+                print("\x1b[2J\x1b[H", end="")  # clear + home
+            print(render(status))
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,10 @@
 One process, alternating telemetry-off / telemetry-on legs over identical
 streams (best-of-N per arm, warm rounds scored) — the measurement behind
 the CLAUDE.md "Observability" overhead contract (<2% on this shape).
+The ON arm runs the FULL stack: registry + live tracer + flight recorder
++ one causal flow lane per round + live SLO evaluators (latency AND
+error-rate feeds) + tail-sampled lane buffering + a 250ms status flusher
+(ISSUE 13; r: -2.2% ≈ noise at the 256-replica shape, envelope holds).
 
 Prints one JSON line.  Defaults to the CPU backend (the sitecustomize
 platform pin means env vars alone cannot select cpu — this script calls

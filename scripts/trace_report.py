@@ -68,13 +68,28 @@ def phase_of(name: str) -> str:
     return "other"
 
 
-def load_events(path: str) -> List[Dict[str, Any]]:
+def load_events(path: str, with_torn: bool = False):
+    """Parse the trace JSONL, tolerating torn lines.
+
+    A SIGKILLed bench child (wedged relay, supervisor timeout) routinely
+    dies mid-write, leaving a truncated trailing line; that must shrink
+    the report by one event, not crash it with JSONDecodeError.  Torn
+    lines are counted and surfaced in the report (``with_torn=True``
+    returns ``(events, torn)``; the default returns just the events for
+    existing callers)."""
     events = []
+    torn = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 events.append(json.loads(line))
+            except json.JSONDecodeError:
+                torn += 1
+    if with_torn:
+        return events, torn
     return events
 
 
@@ -303,7 +318,7 @@ def serve_shard_attribution(events, lanes) -> Optional[Dict[str, Any]]:
     }
 
 
-def analyze(events, top: int = 5) -> Dict[str, Any]:
+def analyze(events, top: int = 5, torn: int = 0) -> Dict[str, Any]:
     lanes = build_lanes(events)
     complete = [l for l in lanes.values() if l["complete"]]
     phase_totals: Dict[str, float] = defaultdict(float)
@@ -377,6 +392,7 @@ def analyze(events, top: int = 5) -> Dict[str, Any]:
         "lanes": len(lanes),
         "complete": len(complete),
         "incomplete": len(lanes) - len(complete),
+        "torn_lines": torn,
         "problems": validate_flows(events),
         "serve_shards": serve_shard_attribution(events, lanes),
         "phase_totals_us": dict(totals),
@@ -400,6 +416,11 @@ def format_report(a: Dict[str, Any]) -> str:
         f"lanes: {a['lanes']} ({a['complete']} complete, "
         f"{a['incomplete']} incomplete)"
     )
+    if a.get("torn_lines"):
+        lines.append(
+            f"torn trailing line(s): {a['torn_lines']} (truncated write — "
+            "SIGKILLed child mid-flush; tolerated, not counted as events)"
+        )
     if a["problems"]:
         lines.append(f"schema problems: {len(a['problems'])}")
         for p in a["problems"][:10]:
@@ -468,7 +489,8 @@ def summary_line(a: Dict[str, Any]) -> str:
         f"p95_us={a['p95_us']:.0f} p99_us={a['p99_us']:.0f} "
         f"top_phase={top_phase}:{100 * top_us / total:.0f}% "
         f"retried={a['retried_lanes']} degraded={a['degraded_lanes']} "
-        f"windowed={100 * a.get('window_frac', 0.0):.0f}%"
+        f"windowed={100 * a.get('window_frac', 0.0):.0f}% "
+        f"torn={a.get('torn_lines', 0)}"
     )
 
 
@@ -478,8 +500,8 @@ def main() -> int:
     parser.add_argument("--top", type=int, default=5, help="slowest lanes to show")
     parser.add_argument("--json", action="store_true", help="machine-readable output")
     args = parser.parse_args()
-    events = load_events(args.trace)
-    a = analyze(events, top=args.top)
+    events, torn = load_events(args.trace, with_torn=True)
+    a = analyze(events, top=args.top, torn=torn)
     if args.json:
         print(json.dumps(a))
     else:

@@ -1,0 +1,723 @@
+"""SLO plane + tail-sampled tracing + status surface suite (ISSUE 13).
+
+What the acceptance criteria pin here:
+
+- a seeded wedge storm with ``PERITEXT_SLO`` armed breaches
+  DETERMINISTICALLY: the breach counter/gauge land identically on replay,
+  and exactly ONE rate-limited black-box dump names the objective;
+- tail sampling at ``PERITEXT_TRACE_SAMPLE=0`` retains 100% of
+  degraded/failed/retried lanes (and breach-coincident lanes under the
+  ``breach`` rule) while dropping every healthy lane, and the sampled
+  trace validates cleanly in trace_report — dropped lanes are absent,
+  never schema errors;
+- ingest stays byte-identical with the FULL new stack on (SLO evaluators
+  + lane buffering + status surface);
+- the status surface carries breaker states, serve lane occupancy,
+  windowed-merge engagement and per-SLO verdicts, writes atomically, and
+  renders through ``scripts/ops_top.py --once``;
+- torn trailing trace lines (SIGKILLed child mid-write) are tolerated and
+  counted by trace_report instead of raising;
+- black-box dumps rate-limit per reason, so a storm cannot exhaust the
+  32-dump cap.
+"""
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from peritext_tpu.oracle import Doc
+from peritext_tpu.ops import TpuUniverse
+from peritext_tpu.runtime import ChangeQueue, faults, health, slo, telemetry
+from peritext_tpu.runtime.faults import FaultPlan
+from peritext_tpu.runtime.slo import SloPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OPS_TOP = os.path.join(REPO, "scripts", "ops_top.py")
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_report", os.path.join(REPO, "scripts", "trace_report.py")
+)
+trace_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_report)
+
+STATE_FIELDS = (
+    "elem_ctr", "elem_act", "deleted", "chars", "bnd_def", "bnd_mask",
+    "mark_ctr", "mark_act", "mark_action", "mark_type", "mark_attr",
+    "length", "mark_count",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    """Pristine telemetry/fault/health/SLO planes around every test; the
+    ambient configuration (e.g. the CI leg's PERITEXT_SLO +
+    PERITEXT_TRACE_TAIL env) is DETACHED and restored afterwards, so the
+    suite-wide trace/status files still accumulate across tests."""
+    saved = (
+        telemetry.enabled,
+        telemetry._tracer,
+        telemetry._metrics_path,
+        telemetry._registry,
+        telemetry._recorder,
+        telemetry._blackbox_dir,
+        telemetry._status_path,
+        telemetry._sample_p,
+        telemetry._sample_seed,
+        telemetry._tail_slow_us,
+        telemetry._tail_error,
+        telemetry._tail_breach,
+        telemetry._observe_sinks,
+        telemetry._counter_sinks,
+        telemetry._breach_probe,
+    )
+    saved_slo = (slo._installed, slo._env_plan, slo._env_spec)
+    saved_sources = list(telemetry._status_sources)
+    saved_seq = telemetry._blackbox_seq
+    import itertools as _it
+
+    # A fresh per-test dump budget: these tests write several dumps and
+    # must neither eat the ambient process's 32-dump cap nor flake when a
+    # long suite run already spent it.
+    telemetry._blackbox_seq = _it.count(1)
+    telemetry.enabled = False
+    telemetry._tracer = None
+    telemetry._metrics_path = None
+    telemetry._registry = telemetry.Registry()
+    telemetry._recorder = None
+    telemetry._blackbox_dir = None
+    telemetry._status_path = None
+    telemetry._sample_p = 1.0
+    telemetry._sample_seed = 0
+    telemetry._tail_slow_us = None
+    telemetry._tail_error = telemetry._tail_breach = False
+    telemetry._observe_sinks = None
+    telemetry._counter_sinks = None
+    telemetry._breach_probe = None
+    telemetry._lane_buf.clear()
+    telemetry._dump_last.clear()
+    slo._installed = None
+    slo._env_plan = None
+    slo._env_spec = None
+    faults.reset()
+    health.reset()
+    monkeypatch.delenv("PERITEXT_FAULTS", raising=False)
+    monkeypatch.delenv("PERITEXT_SLO", raising=False)
+    monkeypatch.setenv("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    yield
+    telemetry.reset()  # closes any tracer the test itself opened
+    (
+        telemetry.enabled,
+        telemetry._tracer,
+        telemetry._metrics_path,
+        telemetry._registry,
+        telemetry._recorder,
+        telemetry._blackbox_dir,
+        telemetry._status_path,
+        telemetry._sample_p,
+        telemetry._sample_seed,
+        telemetry._tail_slow_us,
+        telemetry._tail_error,
+        telemetry._tail_breach,
+        telemetry._observe_sinks,
+        telemetry._counter_sinks,
+        telemetry._breach_probe,
+    ) = saved
+    telemetry._status_sources[:] = saved_sources
+    telemetry._blackbox_seq = saved_seq
+    (slo._installed, slo._env_plan, slo._env_spec) = saved_slo
+    faults.reset()
+    health.reset()
+
+
+def _author_changes(n_edits=3):
+    alice = Doc("alice")
+    genesis, _ = alice.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list("slo drill")},
+        ]
+    )
+    edits = []
+    for i in range(n_edits):
+        c, _ = alice.change(
+            [{"path": ["text"], "action": "insert", "index": i, "values": ["x"]}]
+        )
+        edits.append(c)
+    return genesis, edits
+
+
+def _queue_fleet(genesis, edits, name):
+    """Drive changes through the real seam chain (queue enqueue -> flush ->
+    ingest), one flush per change, so every change gets a causal lane."""
+    uni = TpuUniverse(["r0", "r1"])
+    q = ChangeQueue(
+        lambda chs: [
+            uni.apply_changes_with_patches({"r0": [c], "r1": [c]}) for c in chs
+        ],
+        name=name,
+    )
+    for c in [genesis] + edits:
+        q.enqueue(c)
+        q.flush()
+    return uni
+
+
+def _flow_events(trace):
+    telemetry.flush_trace()
+    events = trace_report.load_events(trace)
+    return events, [e for e in events if e.get("ph") in ("s", "t", "f")]
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_spec_grammar_round_trip():
+    plan = SloPlan.from_spec(
+        "seed=7;e2e.admit_to_applied:p95=50,window=256;"
+        "ingest.launch:err_rate=0.01,window=128,fast=16,burn=2,cooldown=5"
+    )
+    assert plan.seed == 7
+    lat = plan._objectives["e2e.admit_to_applied"]
+    assert lat.latency_targets == {"p95": 0.05}  # ms -> seconds
+    assert lat.window == 256
+    err = plan._objectives["ingest.launch"]
+    assert err.err_rate == 0.01
+    assert err._fast_n() == 16 and err.burn_threshold == 2.0
+    assert err.cooldown == 5.0
+    # The counter-pair convention.
+    observe_map, counter_map = plan.sinks()
+    assert "e2e.admit_to_applied" in observe_map
+    assert set(counter_map) == {
+        "ingest.launch_attempts", "ingest.launch_failures",
+    }
+
+
+def test_spec_rejects_malformed_clauses():
+    with pytest.raises(ValueError):
+        SloPlan.from_spec("e2e.x:p95")  # no value
+    with pytest.raises(ValueError):
+        SloPlan.from_spec("e2e.x:bogus=1,p95=50")  # unknown parameter
+    with pytest.raises(ValueError):
+        SloPlan.from_spec("e2e.x:window=64")  # no objective kind
+    with pytest.raises(ValueError):
+        SloPlan.from_spec("e2e.x:p95=50,err_rate=0.1")  # both kinds
+    with pytest.raises(ValueError):
+        SloPlan.from_spec("e2e.x:err_rate=1.5")  # out of range
+    # Custom counter pair overrides the _attempts/_failures convention.
+    plan = SloPlan.from_spec(
+        "serve.flush:err_rate=0.1,total=serve.flushes,errors=serve.flush_failures"
+    )
+    _, counter_map = plan.sinks()
+    assert set(counter_map) == {"serve.flushes", "serve.flush_failures"}
+
+
+# ---------------------------------------------------------------------------
+# Breach detection
+# ---------------------------------------------------------------------------
+
+
+def test_latency_breach_recovery_and_gauges():
+    telemetry.enable()
+    slo.install("e2e.t:p95=50,window=16,fast=4,min=4,cooldown=60")
+    for _ in range(8):
+        telemetry.observe("e2e.t", 0.01)  # 10ms, compliant
+    assert not slo.summary()["e2e.t"]["breached"]
+    for _ in range(8):
+        telemetry.observe("e2e.t", 0.2)  # 200ms, 4x the target
+    s = slo.summary()["e2e.t"]
+    assert s["breached"] and s["burn"] >= 1.0
+    counters = telemetry.snapshot()["counters"]
+    gauges = telemetry.snapshot()["gauges"]
+    assert counters["slo.e2e.t.breach"] == 1
+    assert gauges["slo.e2e.t.breached"] == 1
+    assert gauges["slo.e2e.t.burn"] >= 1.0
+    # Recovery: a compliant stream refills both windows, clears the gauge.
+    for _ in range(24):
+        telemetry.observe("e2e.t", 0.001)
+    assert not slo.summary()["e2e.t"]["breached"]
+    assert telemetry.snapshot()["gauges"]["slo.e2e.t.breached"] == 0
+    # The summary()["slo"] mirror rides for bench stamps / chaos footers.
+    assert "e2e.t.breach" in telemetry.summary()["slo"]
+    slo.reset()
+
+
+def test_multi_window_rule_ignores_lone_outlier():
+    """One slow event must not breach: the slow window hasn't burned."""
+    telemetry.enable()
+    slo.install("e2e.t:p95=50,window=32,fast=4,min=4")
+    for _ in range(28):
+        telemetry.observe("e2e.t", 0.001)
+    telemetry.observe("e2e.t", 10.0)  # a single 10s outlier
+    s = slo.summary()["e2e.t"]
+    assert not s["breached"], s
+    assert "slo.e2e.t.breach" not in telemetry.snapshot()["counters"]
+    slo.reset()
+
+
+def test_wedge_storm_breach_is_deterministic_with_one_dump(tmp_path, monkeypatch):
+    """The acceptance drill: a seeded wedge storm under an armed
+    PERITEXT_SLO-shaped plan breaches deterministically — same counters on
+    replay — and writes exactly ONE rate-limited dump naming the SLO."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    spec = "seed=11;ingest.launch:err_rate=0.2,window=16,fast=4,min=4,cooldown=60"
+    genesis, edits = _author_changes(n_edits=3)
+
+    def run(tag):
+        box = str(tmp_path / f"box-{tag}")
+        telemetry.reset()
+        telemetry.enable(blackbox=box)
+        slo.install(spec)
+        with faults.injected(
+            FaultPlan(seed=11).with_site("device_launch", fail=99)
+        ):
+            uni = _queue_fleet(genesis, edits, name=f"slo-storm-{tag}")
+        counters = dict(telemetry.snapshot()["counters"])
+        gauges = dict(telemetry.snapshot()["gauges"])
+        summary = slo.summary()
+        dumps = sorted(glob.glob(os.path.join(box, "blackbox-*.json")))
+        slo.reset()
+        telemetry.reset()
+        return uni, counters, gauges, summary, dumps
+
+    uni_a, counters_a, gauges_a, summary_a, dumps_a = run("a")
+    _, counters_b, _, summary_b, _ = run("b")
+    # Deterministic: the seeded storm breaches at the same event on replay.
+    slo_counters_a = {k: v for k, v in counters_a.items() if k.startswith("slo.")}
+    slo_counters_b = {k: v for k, v in counters_b.items() if k.startswith("slo.")}
+    assert slo_counters_a == slo_counters_b
+    assert counters_a["slo.ingest.launch.breach"] == 1
+    assert gauges_a["slo.ingest.launch.breached"] == 1
+    assert gauges_a["slo.ingest.launch.burn"] >= 1.0
+    assert summary_a == summary_b
+    assert summary_a["ingest.launch"]["breached"]
+    # Exactly one slo_breach dump, naming the objective (the storm raged
+    # on for every batch; the per-SLO cooldown kept it to one).
+    slo_dumps = [d for d in dumps_a if "slo_breach" in os.path.basename(d)]
+    assert len(slo_dumps) == 1, dumps_a
+    dump = json.load(open(slo_dumps[0]))
+    assert dump["reason"] == "slo_breach"
+    assert dump["info"]["slo"] == "ingest.launch"
+    assert dump["info"]["burn"] >= 1.0
+    # The storm batches all degraded; output stays byte-identical.
+    assert uni_a.stats["degraded_batches"] == len(edits) + 1
+    control = TpuUniverse(["r0", "r1"])
+    for c in [genesis] + edits:
+        control.apply_changes_with_patches({"r0": [c], "r1": [c]})
+    assert uni_a.texts() == control.texts()
+
+
+# ---------------------------------------------------------------------------
+# Tail-sampled tracing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [3, 11])
+def test_tail_sampling_keeps_all_interesting_lanes(tmp_path, seed, monkeypatch):
+    """PERITEXT_TRACE_SAMPLE=0 + the error rule: every lane that degraded
+    or retried survives (100% retention), every healthy lane drops, and
+    the sampled trace validates — dropped lanes are absent, never schema
+    errors."""
+    monkeypatch.setenv("PERITEXT_LAUNCH_RETRIES", "1")
+    trace = str(tmp_path / f"tail-{seed}.jsonl")
+    telemetry.enable(trace=trace)
+    telemetry.set_trace_sampling(sample=0.0, tail="error")
+    genesis, edits = _author_changes(n_edits=5)
+    # fail=3 under retries=1: batch 1 exhausts its budget and degrades
+    # (2 failures), batch 2 fails once and survives on the retry, the
+    # rest are healthy.
+    with faults.injected(
+        FaultPlan(seed=seed).with_site("device_launch", fail=3)
+    ):
+        _queue_fleet(genesis, edits, name=f"tail-{seed}")
+    events, flows = _flow_events(trace)
+    assert trace_report.validate_flows(events) == []
+    a = trace_report.analyze(events)
+    # The kept lanes are EXACTLY the interesting ones: the degraded batch
+    # and the retry-saved batch; the four healthy lanes dropped.  (The
+    # degraded lane counts as retried too — its retry failed first.)
+    assert a["lanes"] == 2, a
+    assert a["degraded_lanes"] == 1
+    assert a["retried_lanes"] == 2
+    counters = telemetry.snapshot()["counters"]
+    assert counters["trace.lanes_kept"] == 2
+    assert counters["trace.lanes_dropped"] == 4
+    # Determinism: the same seed keeps the same verdict counts on replay.
+    telemetry.reset()
+    trace2 = str(tmp_path / f"tail-{seed}-b.jsonl")
+    telemetry.enable(trace=trace2)
+    telemetry.set_trace_sampling(sample=0.0, tail="error")
+    with faults.injected(
+        FaultPlan(seed=seed).with_site("device_launch", fail=3)
+    ):
+        _queue_fleet(genesis, edits, name=f"tail-{seed}-b")
+    counters2 = telemetry.snapshot()["counters"]
+    assert counters2["trace.lanes_kept"] == counters["trace.lanes_kept"]
+    assert counters2["trace.lanes_dropped"] == counters["trace.lanes_dropped"]
+
+
+def test_sample_zero_without_tail_drops_every_lane(tmp_path):
+    trace = str(tmp_path / "alloff.jsonl")
+    telemetry.enable(trace=trace)
+    telemetry.set_trace_sampling(sample=0.0, tail="")
+    genesis, edits = _author_changes(n_edits=2)
+    _queue_fleet(genesis, edits, name="alloff")
+    events, flows = _flow_events(trace)
+    assert flows == []  # no flow events at all — lanes, not fragments
+    assert any(e.get("ph") == "X" for e in events)  # spans still trace
+    assert trace_report.validate_flows(events) == []
+    counters = telemetry.snapshot()["counters"]
+    assert counters["trace.lanes_dropped"] == len(edits) + 1
+    assert "trace.lanes_kept" not in counters
+
+
+def test_head_sampling_is_deterministic_and_complete_lanes_emit(tmp_path):
+    # The verdict function itself: same (seed, id) -> same verdict.
+    telemetry.set_trace_sampling(sample=0.5, seed=7)
+    verdicts = [telemetry._head_sampled(i) for i in range(200)]
+    assert verdicts == [telemetry._head_sampled(i) for i in range(200)]
+    assert any(verdicts) and not all(verdicts)  # actually samples
+    # A kept lane emits its WHOLE buffered event set (s + t* + f).
+    trace = str(tmp_path / "head.jsonl")
+    telemetry.enable(trace=trace)
+    telemetry.set_trace_sampling(sample=0.999999, seed=0)  # buffered mode
+    ctx = telemetry.flow("unit.lane", tag=1)
+    with telemetry.span("unit.span"):
+        telemetry.flow_point(ctx)
+        telemetry.flow_point(ctx, step="mid")
+        telemetry.flow_point(ctx, terminal=True, outcome="done")
+    events, flows = _flow_events(trace)
+    if flows:  # head-sampled in (p≈1: virtually certain)
+        assert [e["ph"] for e in flows] == ["s", "t", "f"]
+        assert trace_report.validate_flows(events) == []
+
+
+def test_slow_tail_rule_keeps_slow_lane(tmp_path):
+    trace = str(tmp_path / "slow.jsonl")
+    telemetry.enable(trace=trace)
+    telemetry.set_trace_sampling(sample=0.0, tail="slow:20")
+    for slow in (False, True):
+        ctx = telemetry.flow("unit.lane", slow=slow)
+        with telemetry.span("unit.span"):
+            telemetry.flow_point(ctx)
+            if slow:
+                time.sleep(0.03)  # 30ms > the 20ms bar
+            telemetry.flow_point(ctx, terminal=True)
+    events, flows = _flow_events(trace)
+    ids = {e["id"] for e in flows}
+    assert len(ids) == 1  # only the slow lane survived
+    starts = [e for e in flows if e["ph"] == "s"]
+    assert starts and starts[0]["args"] == {"slow": True}
+    counters = telemetry.snapshot()["counters"]
+    assert counters["trace.lanes_kept"] == 1
+    assert counters["trace.lanes_dropped"] == 1
+
+
+def test_breach_tail_rule_keeps_lanes_during_breach(tmp_path):
+    trace = str(tmp_path / "breach.jsonl")
+    telemetry.enable(trace=trace)
+    telemetry.set_trace_sampling(sample=0.0, tail="breach")
+    slo.install("e2e.t:p95=10,window=8,fast=2,min=2")
+
+    def one_lane(tag):
+        ctx = telemetry.flow("unit.lane", tag=tag)
+        with telemetry.span("unit.span"):
+            telemetry.flow_point(ctx)
+            telemetry.flow_point(ctx, terminal=True)
+
+    one_lane("healthy")  # no breach active -> dropped
+    for _ in range(4):
+        telemetry.observe("e2e.t", 5.0)  # 5s >> 10ms: breach
+    assert slo.active().breach_active()
+    one_lane("during-breach")  # breach active -> kept
+    events, flows = _flow_events(trace)
+    starts = [e for e in flows if e["ph"] == "s"]
+    assert len(starts) == 1 and starts[0]["args"] == {"tag": "during-breach"}
+    slo.reset()
+
+
+def test_flow_keep_marks_lane_for_retention(tmp_path):
+    trace = str(tmp_path / "keep.jsonl")
+    telemetry.enable(trace=trace)
+    telemetry.set_trace_sampling(sample=0.0, tail="error")
+    ctx = telemetry.flow("unit.lane")
+    with telemetry.span("unit.span"):
+        telemetry.flow_point(ctx)
+        with telemetry.flowing((ctx,)):
+            telemetry.flow_keep()  # what the degrade/fastfail seams call
+        telemetry.flow_point(ctx, terminal=True)
+    _, flows = _flow_events(trace)
+    assert {e["ph"] for e in flows} == {"s", "f"}
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity with the full stack on
+# ---------------------------------------------------------------------------
+
+_EDIT_OPS = [
+    {"path": ["text"], "action": "insert", "index": 3, "values": list("XY")},
+    {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 8,
+     "markType": "strong"},
+    {"path": ["text"], "action": "delete", "index": 1, "count": 2},
+    {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 9,
+     "markType": "em"},
+]
+
+
+def _author_stream():
+    alice, bob = Doc("alice"), Doc("bob")
+    genesis, _ = alice.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list("peritext slo stack")},
+        ]
+    )
+    bob.apply_change(genesis)
+    c1, _ = alice.change(_EDIT_OPS[:2])
+    c2, _ = bob.change(_EDIT_OPS[2:])
+    return [genesis, c1, c2]
+
+
+def _patched_ingest(changes):
+    uni = TpuUniverse(["r0", "r1"])
+    out = []
+    for change in changes:
+        out.append(uni.apply_changes_with_patches({"r0": [change], "r1": [change]}))
+    plane = {f: np.asarray(getattr(uni.states, f)).copy() for f in STATE_FIELDS}
+    return out, plane, uni.texts()
+
+
+def test_ingest_byte_identical_with_full_stack_on(tmp_path):
+    """OFF vs the whole ISSUE 13 stack (SLO evaluators + tail-sampled
+    tracing + armed status surface): patches, device plane, and texts must
+    not move by a byte."""
+    changes = _author_stream()
+    assert not telemetry.enabled
+    patches_off, plane_off, texts_off = _patched_ingest(changes)
+    telemetry.enable(
+        trace=str(tmp_path / "stack.jsonl"),
+        status_path=str(tmp_path / "status.json"),
+    )
+    telemetry.set_trace_sampling(sample=0.25, tail="slow:10000|error|breach")
+    slo.install(
+        "e2e.admit_to_applied:p95=50,window=64;ingest.launch:err_rate=0.5,window=64"
+    )
+    patches_on, plane_on, texts_on = _patched_ingest(changes)
+    telemetry.dump_status()
+    assert patches_on == patches_off
+    assert texts_on == texts_off
+    for f in STATE_FIELDS:
+        assert (plane_on[f] == plane_off[f]).all(), f"device plane differs at {f}"
+    # The SLO evaluators actually saw the launches.
+    assert slo.summary()["ingest.launch"]["events"] > 0
+    slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# Status surface
+# ---------------------------------------------------------------------------
+
+
+def test_status_surface_sections_and_ops_top(tmp_path):
+    from peritext_tpu.runtime.serve import ServePlane
+
+    telemetry.enable()
+    slo.install("ingest.launch:err_rate=0.5,window=32")
+    health.install("device_launch:threshold=99")
+    changes = _author_stream()
+    uni = TpuUniverse(["r0"])
+    plane = ServePlane(uni, start=False, batch_target=8)
+    s = plane.session("s0", replica="r0")
+    for change in changes:
+        s.submit([change])
+    assert plane.drain() == 0
+    st = telemetry.status()
+    assert st["enabled"]
+    assert "ingest" in st and st["ingest"]["launches"] >= 1
+    assert "window_engagement_pct" in st["ingest"]
+    serve_entries = st["serve"]
+    mine = [p for p in serve_entries if p["plane"] == "serve"]
+    assert mine and mine[0]["sessions"]["s0"]["depth"] == 0
+    assert "deficit" in mine[0]["sessions"]["s0"]
+    assert st["breakers"]["device_launch"]["state"] == "closed"
+    assert st["slo"]["ingest.launch"]["events"] >= 1
+    # Atomic dump + the terminal renderer (CI smoke shape).
+    path = str(tmp_path / "status.json")
+    assert telemetry.dump_status(path) == path
+    proc = subprocess.run(
+        [sys.executable, OPS_TOP, path, "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "slo:" in proc.stdout and "serve plane" in proc.stdout
+    # --once against a missing file fails loudly (the CI contract).
+    proc = subprocess.run(
+        [sys.executable, OPS_TOP, str(tmp_path / "nope.json"), "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    slo.reset()
+    health.reset()
+
+
+def test_sharded_plane_contributes_fleet_status():
+    from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+    telemetry.enable()
+    changes = _author_stream()
+    plane = ShardedServePlane(2, start=False, batch_target=8)
+    s0 = plane.session("s0", replica="r0")
+    s1 = plane.session("s1", replica="r1")
+    s0.submit(changes)
+    s1.submit([dict(c) for c in changes])
+    assert plane.drain() == 0
+    st = telemetry.status()
+    fleets = st.get("serve_shards") or []
+    assert fleets, st.keys()
+    fleet = fleets[-1]
+    assert len(fleet["shards"]) == 2
+    assert fleet["fleet_compiled_shapes"] >= 1
+    occupied = [sh for sh in fleet["shards"] if sh.get("sessions")]
+    assert len(occupied) == 2
+    assert all("width" in sh and "pending" in sh for sh in occupied)
+
+
+def test_status_flusher_writes_periodically(tmp_path):
+    path = str(tmp_path / "live.json")
+    telemetry.enable(status_path=path, metrics_interval=0.05)
+    telemetry.counter("ingest.launches", 2)
+    deadline = time.monotonic() + 10
+    while not os.path.exists(path) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert os.path.exists(path)
+    st = json.load(open(path))
+    assert st["pid"] == os.getpid()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: torn trace lines + dump rate limiting
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_tolerates_torn_trailing_line(tmp_path):
+    trace = str(tmp_path / "torn.jsonl")
+    telemetry.enable(trace=trace)
+    ctx = telemetry.flow("unit.lane")
+    with telemetry.span("unit.span"):
+        telemetry.flow_point(ctx)
+        telemetry.flow_point(ctx, terminal=True)
+    telemetry.flush_trace()
+    with open(trace, "a") as f:
+        f.write('{"name": "torn", "ph": "X", "ts": 1, "du')  # SIGKILL mid-write
+    events, torn = trace_report.load_events(trace, with_torn=True)
+    assert torn == 1
+    a = trace_report.analyze(events, torn=torn)
+    assert a["torn_lines"] == 1
+    assert a["problems"] == []
+    assert a["lanes"] == 1
+    assert "torn=1" in trace_report.summary_line(a)
+    # The default signature keeps returning just the events (existing
+    # callers like blackbox_trip_check).
+    assert trace_report.load_events(trace) == events
+
+
+def test_blackbox_dumps_rate_limit_per_reason(tmp_path):
+    box = str(tmp_path / "box")
+    telemetry.enable(blackbox=box)
+    assert telemetry.blackbox_dump("storm_reason", x=1) is not None
+    # Same reason inside the cooldown: deduped, not written.
+    assert telemetry.blackbox_dump("storm_reason", x=2) is None
+    # A different reason is independent.
+    assert telemetry.blackbox_dump("other_reason") is not None
+    # An explicit dedupe key separates same-reason sources (per-site
+    # breaker trips, per-objective SLO breaches).
+    assert (
+        telemetry.blackbox_dump("storm_reason", dedupe_key="storm_reason:b")
+        is not None
+    )
+    # dedupe_cooldown_s=0 bypasses (callers that rate-limit themselves).
+    assert (
+        telemetry.blackbox_dump("storm_reason", dedupe_cooldown_s=0.0) is not None
+    )
+    counters = telemetry.snapshot()["counters"]
+    assert counters["blackbox.dumps"] == 4
+    assert counters["blackbox.deduped"] == 1
+    assert len(glob.glob(os.path.join(box, "blackbox-*.json"))) == 4
+    assert telemetry.summary()["blackbox_deduped"] == 1
+
+
+def test_breaker_trips_dedupe_per_site(tmp_path):
+    """A trip storm on one site writes one dump per cooldown; the ring cap
+    survives for the NEXT interesting dump (the ISSUE 13 satellite)."""
+    from peritext_tpu.runtime.health import CircuitBreaker
+
+    box = str(tmp_path / "box")
+    telemetry.enable(blackbox=box)
+    br = CircuitBreaker("device_launch", threshold=1, cooldown=0.0, jitter=0.0)
+    for _ in range(5):
+        br.record_failure()  # canary-failure re-trips on each admit cycle
+        br.admit()
+    dumps = glob.glob(os.path.join(box, "blackbox-*breaker_trip.json"))
+    assert len(dumps) == 1, dumps
+    assert telemetry.snapshot()["counters"]["blackbox.deduped"] >= 1
+    # A different site's first trip still dumps.
+    br2 = CircuitBreaker("serve_admit", threshold=1, cooldown=60.0)
+    br2.record_failure()
+    dumps = glob.glob(os.path.join(box, "blackbox-*breaker_trip.json"))
+    assert len(dumps) == 2
+
+
+# ---------------------------------------------------------------------------
+# The disabled-path contract for the new sites
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_new_sites_record_nothing():
+    assert not telemetry.enabled
+    # SLO sinks installed but collection off: the feed sites never fire.
+    slo.install("ingest.launch:err_rate=0.1,window=8")
+    telemetry.counter("ingest.launch_attempts")
+    telemetry.counter("ingest.launch_failures")
+    telemetry.observe("e2e.admit_to_applied", 1.0)
+    telemetry.flow_keep()
+    assert slo.summary()["ingest.launch"]["events"] == 0
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    slo.reset()
+
+
+def test_guarded_rewires_env_plan_on_exit(monkeypatch):
+    """Leaving a scoped slo.guarded() must re-wire a PERITEXT_SLO env
+    plan's sinks (regression: the exit path wired `prev=None`, silently
+    disconnecting the env objectives for the rest of the process while
+    summary() kept showing them frozen)."""
+    telemetry.enable()
+    monkeypatch.setenv("PERITEXT_SLO", "ingest.launch:err_rate=0.5,window=8")
+    env_plan = slo.active()
+    assert env_plan is not None
+    telemetry.counter("ingest.launch_attempts")
+    assert env_plan.objectives()[0].events == 1
+    with slo.guarded("e2e.t:p95=10,window=8"):
+        telemetry.counter("ingest.launch_attempts")  # scoped plan: no feed
+        assert env_plan.objectives()[0].events == 1
+    telemetry.counter("ingest.launch_attempts")  # env plan re-wired
+    assert env_plan.objectives()[0].events == 2
+    assert telemetry._breach_probe is not None
+    slo.reset()
+
+
+def test_status_never_perturbs_and_reports_disabled():
+    st = telemetry.status()
+    assert st["enabled"] is False
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}

@@ -243,6 +243,7 @@ def test_disabled_span_is_shared_and_allocation_free():
         t.flow_point(None)
         t.flow_steps()
         t.flowing(())
+        t.flow_keep()
     tracemalloc.start()
     base = tracemalloc.get_traced_memory()[0]
     for _ in range(1000):
@@ -253,11 +254,15 @@ def test_disabled_span_is_shared_and_allocation_free():
         t.span("z")
         # The causal-flow + flight-recorder sites share the contract:
         # guarded mint, None-propagating points, null flowing context,
-        # recorder no-op — none may allocate while disabled.
+        # recorder no-op — none may allocate while disabled.  The ISSUE 13
+        # additions (tail-keep marking, the SLO feed path inside
+        # counter/observe — exercised above with no sinks installed) ride
+        # the same contract.
         ctx = t.flow("f") if t.enabled else None
         t.flow_point(ctx)
         t.flow_steps()
         t.flowing(())
+        t.flow_keep()
         t.record("r")
     delta = tracemalloc.get_traced_memory()[0] - base
     tracemalloc.stop()
