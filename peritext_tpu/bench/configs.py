@@ -546,6 +546,46 @@ def config8_sharded_serving() -> Dict[str, Any]:
     }
 
 
+def config9_elastic_serving() -> Dict[str, Any]:
+    """Elastic serving under a load spike: static vs autoscaled shard
+    fleet on identical traffic (runtime/elastic.py).
+
+    Every session starts pinned to shard 0; the elastic leg's controller
+    live-migrates the hot shard's sessions to cold shards between traffic
+    bursts.  The record is the late-round p95 admit-to-applied recovery
+    (elastic vs the static control), the migration tally, and the final
+    session distribution — per-session byte-identity between the legs is
+    asserted in-harness.  Env knobs: CONFIG9_SESSIONS / ROUNDS / CHANGES /
+    DOC_LEN / SHARDS / BATCH / TICKS; PERITEXT_ELASTIC_* tune the
+    controller.
+    """
+    from peritext_tpu.bench.workloads import time_elastic_ab
+
+    r = time_elastic_ab(
+        sessions=int(os.environ.get("CONFIG9_SESSIONS", "32")),
+        rounds=int(os.environ.get("CONFIG9_ROUNDS", "10")),
+        changes_per_round=int(os.environ.get("CONFIG9_CHANGES", "4")),
+        doc_len=int(os.environ.get("CONFIG9_DOC_LEN", "400")),
+        shards=int(os.environ.get("CONFIG9_SHARDS", "4")),
+        batch_target=int(os.environ.get("CONFIG9_BATCH", "16")),
+        ticks_per_round=int(os.environ.get("CONFIG9_TICKS", "4")),
+    )
+    static, elastic = r["legs"]
+    return {
+        "config": 9,
+        "workload": f"{r['sessions']}-session load spike on shard 0 of "
+        f"{r['shards']}, {r['rounds']} rounds x {r['changes_per_round']} "
+        f"changes/session, {r['doc_len']}-char docs",
+        "byte_identity": r["byte_identity"],
+        "recovered": r["recovered"],
+        "static_late_p95_ms": round(static["late_p95_s"] * 1000, 1),
+        "elastic_late_p95_ms": round(elastic["late_p95_s"] * 1000, 1),
+        "elastic_early_p95_ms": round(elastic["early_p95_s"] * 1000, 1),
+        "migrations": (elastic.get("controller") or {}).get("migrations", 0),
+        "final_shard_sessions": elastic["shard_sessions"],
+    }
+
+
 CONFIGS = {
     1: config1_trace_replay,
     2: config2_fuzz_style,
@@ -555,6 +595,7 @@ CONFIGS = {
     6: config6_patched_fleet,
     7: config7_serving_plane,
     8: config8_sharded_serving,
+    9: config9_elastic_serving,
 }
 
 

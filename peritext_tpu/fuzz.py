@@ -258,6 +258,7 @@ def fuzz(
     chaos_quiesce: int = 8,
     serve: bool = False,
     serve_shards: int = 1,
+    migrate_every: int = 0,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -301,6 +302,13 @@ def fuzz(
     replica (``accumulate_patches``) — the serving-plane byte-identity
     claim under the same adversarial schedules as the engines.
 
+    With ``migrate_every`` (sharded serve mode only), every N iterations a
+    random session live-migrates to a random other shard through the full
+    elastic protocol (runtime/elastic.py) — under chaos an installed fault
+    plan's ``shard_migrate`` site can fail any protocol step, and the
+    rollback must keep every quiesce's convergence and byte-identity
+    asserts green.
+
     With ``nested``, a share of iterations drive the host structural plane
     (nested makeMap/makeList/set/del, second-list edits and marks) and every
     sync additionally asserts root-view and nested-list-span convergence.
@@ -315,6 +323,8 @@ def fuzz(
         check_patches = False
     if chaos and chaos_quiesce < 1:
         raise ValueError(f"chaos_quiesce must be >= 1, got {chaos_quiesce}")
+    if migrate_every and not (serve and serve_shards > 1):
+        raise ValueError("migrate_every requires serve mode with shards > 1")
     chaos_plan = FaultPlan.from_spec(chaos, seed=seed) if chaos else None
     docs, all_patches, initial_change = generate_docs(initial_text, num_docs)
     if doc_factory is not Doc:
@@ -533,6 +543,7 @@ def fuzz(
 
     done = 0
     max_doc_len = 0
+    migrate_stats = {"attempts": 0, "migrations": 0, "rollbacks": 0}
     # True while chaotic syncs have happened since the last fault-free
     # quiesce (drives both the heartbeat wording and the mandatory final
     # quiesce — `done % chaos_quiesce` alone misses a no-op last iteration).
@@ -637,6 +648,26 @@ def fuzz(
                     serve_check(docs_synced=False)
             check_pair(left, right)
             verified = True
+        if migrate_every and done % migrate_every == 0:
+            # Live migration under fire (ISSUE 17): every N iterations a
+            # random session moves to a random OTHER shard mid-stream via
+            # the full elastic protocol (drain -> export -> provision ->
+            # import -> commit).  Under chaos an installed fault plan's
+            # ``shard_migrate`` site can fail any step — the rollback must
+            # leave the source shard authoritative, and the next quiesce's
+            # cross-shard convergence + byte-identity asserts hold either
+            # way.
+            from peritext_tpu.runtime import elastic as _elastic
+
+            victim = docs[rng.randrange(len(docs))]
+            sess = serve_sessions[victim.actor_id]
+            target_shard = (sess.shard + rng.randrange(1, serve_shards)) % serve_shards
+            migrate_stats["attempts"] += 1
+            try:
+                _elastic.migrate_session(serve_plane, f"s-{victim.actor_id}", target_shard)
+                migrate_stats["migrations"] += 1
+            except _elastic.MigrationError:
+                migrate_stats["rollbacks"] += 1
         # Progress AFTER the iteration's checks: a soak line only claims
         # "ok" for iterations that actually converged — chaotic
         # non-quiesce iterations still emit a heartbeat (a wedged soak must
@@ -682,6 +713,7 @@ def fuzz(
         "window_stats": window_stats,
         "final_spans": docs[0].get_text_with_formatting(["text"]),
         "serve_stats": dict(serve_plane.stats) if serve_plane is not None else None,
+        "migrate_stats": migrate_stats if migrate_every else None,
     }
 
 
@@ -713,6 +745,15 @@ def _main() -> None:
         "document group — the plane's pubsub fan-out + anti-entropy run "
         "under the same chaotic delivery, and every quiesce asserts "
         "byte-identical convergence across shards",
+    )
+    parser.add_argument(
+        "--migrate-every", type=int, default=0, metavar="N",
+        help="with --serve --shards K: live-migrate a random session to a "
+        "random other shard every N iterations via the full elastic "
+        "protocol (runtime/elastic.py); under --chaos a fault plan's "
+        "shard_migrate site can fail any step and the rollback must keep "
+        "every quiesce's convergence + byte-identity asserts green "
+        "(0 = never)",
     )
     parser.add_argument(
         "--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC, default=None, metavar="SPEC",
@@ -793,6 +834,7 @@ def _main() -> None:
             chaos_quiesce=args.chaos_quiesce,
             serve=args.serve or args.shards > 1,
             serve_shards=args.shards,
+            migrate_every=args.migrate_every,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")
@@ -807,6 +849,13 @@ def _main() -> None:
         f"ok: {result['iterations']} iterations, final doc length "
         f"{sum(len(s['text']) for s in result['final_spans'])}"
     )
+    if result.get("migrate_stats"):
+        ms = result["migrate_stats"]
+        print(
+            f"migrate: {ms['migrations']}/{ms['attempts']} sessions moved "
+            f"live ({ms['rollbacks']} rolled back)",
+            flush=True,
+        )
     if args.growth:
         ws = result["window_stats"]
         engaged = (

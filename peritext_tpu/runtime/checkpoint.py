@@ -234,6 +234,145 @@ def _load_universe(path: str) -> TpuUniverse:
     return uni
 
 
+def _row_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """Deterministic digest of one replica row's state arrays (field order,
+    dtype and shape included, so a torn or re-shaped handoff can't verify)."""
+    h = hashlib.sha256()
+    for f in _STATE_FIELDS:
+        a = np.ascontiguousarray(arrays[f])
+        h.update(f"{f}:{a.dtype}:{a.shape};".encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def export_replica(uni: TpuUniverse, replica: str) -> Dict[str, Any]:
+    """Snapshot ONE replica row as a self-contained in-memory payload.
+
+    The live-migration handoff (runtime/elastic.py): the payload carries the
+    row's device state (D2H), its host control planes (clock / length / mark
+    count / object store / text binding), and the SOURCE universe's intern
+    tables — elem_act / mark_act hold registry-LOCAL actor ids and mark_attr
+    holds AttrRegistry-local ids, so :func:`import_replica` must remap them
+    into the target's registries.  A digest over the state arrays rides
+    along; import verifies it so a torn handoff fails loudly instead of
+    corrupting the target fleet.
+    """
+    with telemetry.span("checkpoint.export_replica", replica=replica):
+        i = uni.index_of[replica]
+        arrays = {
+            f: np.asarray(np.asarray(getattr(uni.states, f))[i])
+            for f in _STATE_FIELDS
+        }
+        payload = {
+            "replica": replica,
+            "arrays": arrays,
+            "capacity": uni.capacity,
+            "max_mark_ops": uni.max_mark_ops,
+            "clock": dict(uni.clocks[i]),
+            "length": uni.lengths[i],
+            "mark_count": uni.mark_counts[i],
+            "store": uni.stores[i].to_json(),
+            "text_obj": uni.text_objs[i],
+            "actors": uni.actors.actors,
+            "attrs": uni.attrs.values,
+            "digest": _row_digest(arrays),
+        }
+    if telemetry.enabled:
+        telemetry.counter("checkpoint.replica_exports")
+    return payload
+
+
+def import_replica(uni: TpuUniverse, replica: str, payload: Dict[str, Any]) -> None:
+    """Graft an exported replica row onto an EMPTY row of another universe.
+
+    The target row must never have ingested anything (empty clock) — the
+    migration protocol provisions it via the pow2 pad plane + rename.  Actor
+    and attr ids are remapped through the target registries with MASKS
+    (elem_act only where ``elem_ctr > 0``, mark rows only below
+    ``mark_count``, attrs only where ``>= 0``): inert slots hold 0, which is
+    a *valid* intern id, and rewriting them would scramble dead-slot
+    contents the kernels rely on being stable.  ``bnd_mask`` needs no remap
+    (bits index the same replica's mark-op rows, which move row-for-row).
+    Capacities reconcile both ways: the target grows to fit the payload
+    (pow2, normal `_ensure_capacity`), a smaller payload row grows to the
+    target's buckets.
+    """
+    with telemetry.span("checkpoint.import_replica", replica=replica):
+        _import_replica(uni, replica, payload)
+    if telemetry.enabled:
+        telemetry.counter("checkpoint.replica_imports")
+
+
+def _import_replica(uni: TpuUniverse, replica: str, payload: Dict[str, Any]) -> None:
+    from peritext_tpu.ops.state import grow_state
+    from peritext_tpu.ops.universe import fold_multi_groups
+
+    i = uni.index_of[replica]
+    if uni.clocks[i]:
+        raise ValueError(
+            f"cannot import over non-empty replica {replica!r} "
+            f"(clock {uni.clocks[i]}); provision a fresh row first"
+        )
+    arrays = payload["arrays"]
+    if _row_digest(arrays) != payload["digest"]:
+        raise ValueError(
+            f"replica payload digest mismatch for {replica!r} "
+            "(torn or corrupted handoff)"
+        )
+    # Grow the target's buckets to fit the payload, then the payload row to
+    # the target's (possibly already larger) buckets.
+    uni._ensure_capacity(payload["capacity"], payload["max_mark_ops"])
+    # Masked intern-id remap through the TARGET registries.
+    actor_map = np.asarray(
+        [uni.actors.intern(a) for a in payload["actors"]], np.int32
+    )
+    attr_map = np.asarray(
+        [uni.attrs.intern(a) for a in payload["attrs"]], np.int32
+    )
+    elem_act = np.array(arrays["elem_act"], np.int32)
+    live = np.asarray(arrays["elem_ctr"]) > 0
+    if actor_map.size:
+        elem_act[live] = actor_map[elem_act[live]]
+    mark_act = np.array(arrays["mark_act"], np.int32)
+    mark_attr = np.array(arrays["mark_attr"], np.int32)
+    mc = int(payload["mark_count"])
+    if mc and actor_map.size:
+        mark_act[:mc] = actor_map[mark_act[:mc]]
+    has_attr = np.zeros(mark_attr.shape, bool)
+    has_attr[:mc] = mark_attr[:mc] >= 0
+    if attr_map.size:
+        mark_attr[has_attr] = attr_map[mark_attr[has_attr]]
+    remapped = dict(arrays)
+    remapped["elem_act"] = elem_act
+    remapped["mark_act"] = mark_act
+    remapped["mark_attr"] = mark_attr
+    row = DocState(**{f: jax.numpy.asarray(remapped[f]) for f in _STATE_FIELDS})
+    if row.capacity < uni.capacity or row.max_mark_ops < uni.max_mark_ops:
+        row = grow_state(row, uni.capacity, uni.max_mark_ops)
+    # One scatter per leaf; assigning ``uni.states`` auto-invalidates the
+    # causal mirror (token keyed to the pytree object).
+    uni.states = jax.tree.map(
+        lambda full, r: full.at[i].set(r), uni.states, row
+    )
+    uni._wcaches = None  # row contents changed under the winner cache
+    uni.clocks[i] = dict(payload["clock"])
+    uni.lengths[i] = int(payload["length"])
+    uni.mark_counts[i] = int(payload["mark_count"])
+    uni.stores[i] = ObjectStore.from_json(payload["store"])
+    uni._store_version_counter += 1
+    uni.store_versions[i] = uni._store_version_counter
+    uni.text_objs[i] = payload["text_obj"]
+    # Fold the imported mark rows (REMAPPED ids) into the allowMultiple
+    # group census so the cached-patch-scan gate stays conservative.
+    fold_multi_groups(
+        uni._multi_groups,
+        types=np.asarray(arrays["mark_type"])[:mc],
+        attr_ids=mark_attr[:mc],
+        ctrs=np.asarray(arrays["mark_ctr"])[:mc],
+        act_ids=mark_act[:mc],
+    )
+
+
 class CheckpointManager:
     """Rotating snapshot schedule: save every ``interval`` steps, keep the
     newest ``keep`` snapshots, resume from the newest loadable one.

@@ -24,6 +24,11 @@ site                      fired from
 ``serve_admit``           serving-plane session admission (runtime/serve.py;
                           ``fail``/``wedge`` hit the submit call,
                           drop/dup/reorder filter the submitted changes)
+``shard_migrate``         live session migration between serving shards
+                          (runtime/elastic.py; ``fail``/``wedge`` hit every
+                          protocol step — drain, export, provision, import,
+                          commit — and drop/dup/reorder filter the parked
+                          submissions replayed onto the target shard)
 ========================  ====================================================
 
 Schedules per site (all deterministic given the plan seed and call order):
@@ -67,6 +72,7 @@ KNOWN_SITES = (
     "checkpoint_write",
     "log_append",
     "serve_admit",
+    "shard_migrate",
 )
 
 _STAT_KEYS = ("fired", "failed", "wedged", "dropped", "duplicated", "reordered", "corrupted")

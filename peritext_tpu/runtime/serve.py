@@ -483,6 +483,28 @@ class ServePlane:
                 telemetry.gauge("serve.sessions", len(self._sessions))
         return s
 
+    def evict_session(self, name: str) -> List[Submission]:
+        """Detach a session from this plane (live migration, elastic.py).
+
+        Runs under ``run_quiesced`` semantics on the caller's side: no
+        cohort may be forming while a session leaves mid-protocol.  Any
+        submissions still in the lane are popped and RETURNED — unresolved,
+        so the migration can graft them onto the target plane's lane and
+        the callers' futures still resolve with their exact patches.  The
+        replica row itself stays in the universe; evacuating it is the
+        caller's job."""
+        with self._lock:
+            s = self._sessions.pop(name, None)
+            if s is None:
+                raise KeyError(f"unknown session {name!r}")
+            self._by_replica.pop(s.replica, None)
+            leftover = list(s._lane)
+            s._lane = []
+            s._pending = 0
+            if telemetry.enabled:
+                telemetry.gauge("serve.sessions", len(self._sessions))
+        return leftover
+
     # -- admission -----------------------------------------------------------
 
     def _submit(

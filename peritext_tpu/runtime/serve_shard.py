@@ -47,8 +47,11 @@ a full ServePlane with the same admission gate.
 Manual mode (``start=False``) steps/drains every shard deterministically;
 threaded mode runs one scheduler thread per shard.  Env defaults:
 ``PERITEXT_SERVE_SHARDS`` (shard count), ``PERITEXT_SERVE_SHARD_BUCKET``
-(``pow2`` | ``exact``), plus the per-shard planes' own
-``PERITEXT_SERVE_*`` knobs.
+(``pow2`` | ``exact``), ``PERITEXT_SERVE_PLACEMENT`` (``rr`` | ``load`` —
+new sessions round-robin or join the least-loaded shard),
+``PERITEXT_ELASTIC=1`` (attach the SLO-driven autoscaler,
+runtime/elastic.py), plus the per-shard planes' own ``PERITEXT_SERVE_*``
+knobs.
 """
 from __future__ import annotations
 
@@ -73,6 +76,54 @@ _log = logging.getLogger(__name__)
 BUCKET_POW2 = "pow2"
 BUCKET_EXACT = "exact"
 _BUCKETS = (BUCKET_POW2, BUCKET_EXACT)
+
+PLACEMENT_RR = "rr"
+PLACEMENT_LOAD = "load"
+_PLACEMENTS = (PLACEMENT_RR, PLACEMENT_LOAD)
+
+
+class ParkedSubmission:
+    """Future handed to a client whose submit landed while its session was
+    mid-migration (runtime/elastic.py).  The migration's commit (or
+    rollback) replays the park buffer onto the surviving inner session and
+    binds each wrapper to the real :class:`~peritext_tpu.runtime.serve.
+    Submission`; ``result``/``done`` then delegate, so callers cannot tell
+    a parked submit from a direct one."""
+
+    __slots__ = ("_bound", "_sub", "_error")
+
+    def __init__(self) -> None:
+        self._bound = threading.Event()
+        self._sub: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _bind(self, sub: Any) -> None:
+        self._sub = sub
+        self._bound.set()
+
+    def _reject(self, error: BaseException) -> None:
+        self._error = error
+        self._bound.set()
+
+    def done(self) -> bool:
+        if not self._bound.is_set():
+            return False
+        return True if self._error is not None else self._sub.done()
+
+    def result(self, timeout: Optional[float] = None):
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        if not self._bound.wait(timeout):
+            raise TimeoutError(
+                f"parked submission still migrating after {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        remaining = (
+            None if deadline is None else max(0.0, deadline - _time.monotonic())
+        )
+        return self._sub.result(timeout=remaining)
 
 
 class _GroupLog:
@@ -132,6 +183,12 @@ class ShardSession:
         self.doc = doc
         self.name = inner.name
         self.replica = inner.replica
+        # Live-migration parking (runtime/elastic.py): non-None while a
+        # migration of THIS session is mid-protocol — deliveries buffer
+        # here and the commit/rollback replays them onto the surviving
+        # inner session.  None on the hot path, so submit()/_deliver()
+        # pay exactly one attribute check when elasticity is off.
+        self._parked: Optional[List[Tuple[List[Change], Optional[ParkedSubmission]]]] = None
 
     @property
     def patch_log(self):
@@ -157,12 +214,24 @@ class ShardSession:
             # history must reject loudly up front, never after the local
             # shard already accepted the submission.
             self._plane._record(self, changes)
-        sub = self._inner.submit(changes)
+        if self._parked is not None:
+            sub = self._plane._park(self, changes)
+        else:
+            sub = self._inner.submit(changes)
         if self.doc is not None and changes:
             self._plane._fan_out(self, changes)
         if wait:
             return sub.result(timeout=timeout)
         return sub
+
+    def _deliver(self, changes: Sequence[Change]) -> None:
+        """Cross-shard delivery entry (live fan-out, anti-entropy): parks
+        during a migration of this session, else straight to the
+        shard-local admission lane."""
+        if self._parked is not None:
+            self._plane._park(self, list(changes), deliver=True)
+            return
+        self._inner.submit(changes)
 
 
 class _Shard:
@@ -200,6 +269,7 @@ class ShardedServePlane:
         start: bool = True,
         name: str = "serve",
         bucket: Optional[str] = None,
+        placement: Optional[str] = None,
         capacity: int = 256,
         max_mark_ops: int = 64,
         universe_factory: Optional[Callable[[List[str], int], Any]] = None,
@@ -214,8 +284,17 @@ class ShardedServePlane:
             raise ValueError(
                 f"unknown bucket policy {bucket!r}; known: {', '.join(_BUCKETS)}"
             )
+        placement = placement or os.environ.get(
+            "PERITEXT_SERVE_PLACEMENT", PLACEMENT_RR
+        )
+        if placement not in _PLACEMENTS:
+            raise ValueError(
+                f"unknown placement policy {placement!r}; "
+                f"known: {', '.join(_PLACEMENTS)}"
+            )
         self.name = name
         self.bucket = bucket
+        self.placement = placement
         self._capacity = capacity
         self._max_mark_ops = max_mark_ops
         self._universe_factory = universe_factory
@@ -243,6 +322,15 @@ class ShardedServePlane:
         telemetry.register_status_source("serve_shards", self._status)
         if telemetry.enabled:
             telemetry.gauge("serve.shards", n)
+        # SLO-driven autoscaler (ISSUE 17): PERITEXT_ELASTIC=1 attaches
+        # the control loop; elastic.py takes the plane as an argument, so
+        # no import cycle.  Off by default — the serving hot paths then
+        # pay only the one _parked attribute check above.
+        self.elastic: Any = None
+        if os.environ.get("PERITEXT_ELASTIC", "") not in ("", "0"):
+            from peritext_tpu.runtime.elastic import ElasticController
+
+            self.elastic = ElasticController(self, start=start)
 
     def _status(self) -> Dict[str, Any]:
         with self._lock:
@@ -377,6 +465,68 @@ class ShardedServePlane:
 
         shard.plane.run_quiesced(mutate)
 
+    def _bucket_target(self, shard: _Shard) -> int:
+        return max(
+            1,
+            _bucket_pow2(len(shard.real))
+            if self.bucket == BUCKET_POW2
+            else len(shard.real),
+        )
+
+    def _evacuate_locked(self, shard: _Shard, replica: str) -> None:
+        """Remove a migrated-away real replica from its shard, holding the
+        width to the bucket policy — the inverse of
+        :meth:`_provision_locked`.  ``drop_replicas`` refuses to empty a
+        universe, so a lone row swaps for a fresh pad instead; excess pads
+        past the (possibly shrunken) bucket drop with the row in ONE
+        gather where they can."""
+        shard.real.remove(replica)
+        target = self._bucket_target(shard)
+
+        def mutate() -> None:
+            uni = shard.universe
+            width = len(uni.replica_ids)
+            if target > width - 1:
+                # Dropping the row would under-shoot the bucket (or empty
+                # the universe): pad up first so the drop lands on-width.
+                uni.add_replicas(self._mint_pads(shard, target - (width - 1)))
+            drop = [replica]
+            excess = len(uni.replica_ids) - 1 - target
+            while excess > 0 and shard.pad_ids:
+                drop.append(shard.pad_ids.pop())
+                excess -= 1
+            uni.drop_replicas(drop)
+            self._reshard_slice(shard)
+
+        shard.plane.run_quiesced(mutate)
+
+    def _unprovision_locked(self, shard: _Shard, replica: str) -> None:
+        """Roll a provisioned-but-unbound replica row back out (migration
+        rollback): an untouched row rebinds to a fresh pad (width pinned,
+        zero device work); a row the failed import already wrote drops the
+        hard way."""
+        shard.real.remove(replica)
+
+        def mutate() -> None:
+            uni = shard.universe
+            i = uni.index_of[replica]
+            if not uni.clocks[i]:
+                uni.rename_replica(replica, self._mint_pads(shard, 1)[0])
+            else:
+                uni.add_replicas(self._mint_pads(shard, 1))
+                uni.drop_replicas([replica])
+            # Trim pads past the bucket so rollback restores the exact
+            # pre-provision width (compiled-shape pressure unchanged).
+            target = self._bucket_target(shard)
+            drop: List[str] = []
+            while len(uni.replica_ids) - len(drop) > target and shard.pad_ids:
+                drop.append(shard.pad_ids.pop())
+            if drop:
+                uni.drop_replicas(drop)
+            self._reshard_slice(shard)
+
+        shard.plane.run_quiesced(mutate)
+
     # -- sessions ------------------------------------------------------------
 
     def session(
@@ -402,8 +552,11 @@ class ShardedServePlane:
                     f"{self._by_replica[replica].name!r}"
                 )
             if shard is None:
-                shard = self._next_shard
-                self._next_shard = (self._next_shard + 1) % len(self.shards)
+                if self.placement == PLACEMENT_LOAD:
+                    shard = self._least_loaded_locked()
+                else:
+                    shard = self._next_shard
+                    self._next_shard = (self._next_shard + 1) % len(self.shards)
             if not (0 <= shard < len(self.shards)):
                 raise ValueError(
                     f"shard {shard} out of range [0, {len(self.shards)})"
@@ -423,13 +576,62 @@ class ShardedServePlane:
                         "members": [],
                     }
                 group["members"].append(sess)
+                # Deliveries route through _deliver so a mid-migration
+                # sibling parks them instead of racing the row handoff.
                 group["publisher"].subscribe(
-                    name, lambda change, s=sess: s._inner.submit([change])
+                    name, lambda change, s=sess: s._deliver([change])
                 )
             if telemetry.enabled:
                 telemetry.gauge("serve.sessions", len(self._sessions))
                 telemetry.counter(f"serve.shard.{shard}.sessions")
         return sess
+
+    # -- placement + load ----------------------------------------------------
+
+    def _shard_load_locked(self, shard: _Shard) -> int:
+        """One shard's admission load: pending (admitted, unapplied)
+        changes across its lanes plus its session count — the tie-break
+        metric placement and the autoscaler agree on."""
+        if shard.plane is None:
+            return 0
+        with shard.plane._lock:
+            pending = sum(s._pending for s in shard.plane._sessions.values())
+        return pending + len(shard.real)
+
+    def _least_loaded_locked(self) -> int:
+        """The ``load`` placement policy: the shard minimizing (load,
+        sessions, index) — deterministic, and biased toward genuinely
+        empty shards over merely-idle ones."""
+        return min(
+            range(len(self.shards)),
+            key=lambda i: (
+                self._shard_load_locked(self.shards[i]),
+                len(self.shards[i].real),
+                i,
+            ),
+        )
+
+    # -- migration parking (runtime/elastic.py) ------------------------------
+
+    def _park(
+        self,
+        sess: ShardSession,
+        changes: List[Change],
+        deliver: bool = False,
+    ):
+        """Buffer a delivery that raced a live migration.  Re-checks the
+        parked flag under the facade lock: a caller that read a stale flag
+        after the migration already unparked routes straight to the (by
+        then rebound) inner session instead of stranding the changes in a
+        dead buffer."""
+        with self._lock:
+            if sess._parked is not None:
+                wrapper = None if deliver else ParkedSubmission()
+                sess._parked.append((changes, wrapper))
+                if telemetry.enabled:
+                    telemetry.counter("elastic.parked_deliveries")
+                return wrapper
+        return sess._inner.submit(changes)
 
     # -- cross-shard anti-entropy --------------------------------------------
 
@@ -482,19 +684,28 @@ class ShardedServePlane:
         pending: List[Tuple[ShardSession, List[Change]]] = []
         for group, members in groups:
             for sess in members:
+                if sess._parked is not None:
+                    # Mid-migration: the commit replays the group-log tail
+                    # itself; redelivering here would race the row handoff.
+                    continue
                 shard = self.shards[sess.shard]
                 if shard.plane is None:
                     continue
-                clock = shard.plane.run_quiesced(
-                    lambda s=shard, r=sess.replica: s.universe.clock(r)
-                )
+                try:
+                    clock = shard.plane.run_quiesced(
+                        lambda s=shard, r=sess.replica: s.universe.clock(r)
+                    )
+                except KeyError:
+                    # The row moved shards between the membership snapshot
+                    # and this read; the next pass sees the new home.
+                    continue
                 with self._lock:
                     missing = group["log"].contiguous(clock)
                 if missing:
                     pending.append((sess, missing))
         redelivered = 0
         for sess, missing in pending:
-            sess._inner.submit(missing)
+            sess._deliver(missing)
             redelivered += len(missing)
         if redelivered and telemetry.enabled:
             telemetry.counter("serve.anti_entropy_changes", redelivered)
@@ -526,6 +737,8 @@ class ShardedServePlane:
             plane.flush_and_wait(timeout=timeout)
 
     def close(self, reject_pending: bool = True) -> None:
+        if self.elastic is not None:
+            self.elastic.close()
         for plane in self._planes():
             plane.close(reject_pending=reject_pending)
 

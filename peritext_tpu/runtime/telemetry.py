@@ -1172,6 +1172,16 @@ def summary() -> Dict[str, Any]:
         if "serve.depth_max" in gauges:
             serve_mirror["depth_max"] = gauges["serve.depth_max"]
         out["serve"] = serve_mirror
+    # Autoscaler tallies (runtime/elastic.py): migrations / rollbacks /
+    # parked-delivery counts ride bench stamps and the fuzz footer the
+    # same way the serve block does.
+    elastic_mirror = {
+        name[len("elastic.") :]: n
+        for name, n in counters.items()
+        if name.startswith("elastic.")
+    }
+    if elastic_mirror:
+        out["elastic"] = elastic_mirror
     # End-to-end latency percentiles (the causal-flow plane's terminal
     # seams) + the key per-seam latencies, estimated from the log2
     # histograms — the "why was p99 40x the median" numbers a one-line

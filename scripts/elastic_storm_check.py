@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""CI drill: a seeded migration-failure storm must roll back cleanly.
+
+Runs a 3-shard serving fleet through a live-migration storm with the
+``shard_migrate`` fault site armed and PERITEXT_BLACKBOX set, then
+asserts:
+
+- every induced failure raised MigrationError, rolled back to the source
+  shard, and left the park buffer empty;
+- exactly one rate-limited black-box dump per FAILING SESSION (a repeat
+  failure on the same session within the cooldown dedupes — counted, not
+  dumped);
+- after the storm the same migrations succeed, and every session's
+  concatenated patch stream is byte-identical to direct per-change ingest
+  (the migration byte-identity contract, end to end);
+- with the tracer on, the flow-event graph validates
+  (scripts/trace_report.py schema pass) — migration lanes included.
+
+Exit 0 on success; any assertion failure exits non-zero.  CI runs it in
+the test-chaos-health job right after blackbox_trip_check.py.
+"""
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    os.environ.setdefault("PERITEXT_LAUNCH_RETRIES", "1")
+
+    blackbox_dir = os.environ.get("PERITEXT_BLACKBOX") or tempfile.mkdtemp(
+        prefix="peritext-elastic-"
+    )
+    trace_path = os.environ.get("PERITEXT_TRACE") or os.path.join(
+        blackbox_dir, "storm_trace.jsonl"
+    )
+
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.runtime import faults, telemetry
+    from peritext_tpu.runtime.elastic import MigrationError, migrate_session
+    from peritext_tpu.runtime.faults import FaultPlan
+    from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+    telemetry.reset()
+    telemetry.enable(trace=trace_path, blackbox=blackbox_dir)
+
+    def author(actor, n, seed):
+        d = Doc(actor)
+        genesis, _ = d.change(
+            [
+                {"path": [], "action": "makeList", "key": "text"},
+                {"path": ["text"], "action": "insert", "index": 0,
+                 "values": list(f"storm drill {actor}")},
+            ]
+        )
+        changes = [genesis]
+        for i in range(n):
+            c, _ = d.change(
+                [{"path": ["text"], "action": "insert", "index": (seed + i) % 5,
+                  "values": [chr(ord("a") + (seed + i) % 26)]}]
+            )
+            changes.append(c)
+        return changes
+
+    names = [f"st{i}" for i in range(3)]
+    streams = [author(n, 8, seed=10 + i) for i, n in enumerate(names)]
+
+    plane = ShardedServePlane(3, start=False, batch_target=64, deadline_ms=10**9)
+    sess = [
+        plane.session(f"s{i}", replica=names[i], shard=0, record_stream=True)
+        for i in range(3)
+    ]
+    for i in range(3):
+        sess[i].submit(streams[i][:4])
+    assert plane.drain() == 0
+
+    # The storm: the first 3 shard_migrate chokepoint firings fail —
+    # s0's attempt, s0 AGAIN (same dedupe key, inside the cooldown), then
+    # s1's attempt.  Two failing sessions -> exactly two dumps; the
+    # repeat -> one dedupe count.
+    plan = FaultPlan(seed=7).with_site("shard_migrate", fail=3)
+    failures = 0
+    with faults.injected(plan):
+        for victim in ("s0", "s0", "s1"):
+            try:
+                migrate_session(plane, victim, 1)
+                raise AssertionError(f"storm migration of {victim} succeeded")
+            except MigrationError:
+                failures += 1
+        # Budget spent: the same migrations now succeed.
+        migrate_session(plane, "s0", 1)
+        migrate_session(plane, "s1", 2)
+    assert failures == 3
+    assert plan.stats["shard_migrate"]["failed"] == 3, plan.stats
+
+    # Rollbacks left the fleet coherent: finish the traffic and hold the
+    # byte-identity wall against direct per-change ingest.
+    for i in range(3):
+        sess[i].submit(streams[i][4:])
+    assert plane.drain() == 0
+    control = TpuUniverse(names)
+    want = {n: [] for n in names}
+    for i, n in enumerate(names):
+        for c in streams[i]:
+            out = control.apply_changes_with_patches({n: [c]})
+            want[n].extend(out[n])
+    for i, n in enumerate(names):
+        assert sess[i].patch_log == want[n], f"stream diverged for {n}"
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("elastic.rollbacks", 0) == 3, counters
+    assert counters.get("elastic.migration_failures", 0) == 3, counters
+    assert counters.get("elastic.migrations", 0) == 2, counters
+    assert counters.get("blackbox.deduped", 0) >= 1, counters
+
+    dumps = sorted(glob.glob(os.path.join(blackbox_dir, "blackbox-*.json")))
+    storm_dumps = [d for d in dumps if "shard_migrate_failed" in os.path.basename(d)]
+    assert len(storm_dumps) == 2, (
+        f"expected exactly 2 migration dumps (one per failing session), "
+        f"got {storm_dumps}"
+    )
+    with open(storm_dumps[-1]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "shard_migrate_failed"
+    assert dump["info"]["session"] in ("s0", "s1"), dump["info"]
+
+    plane.close()
+    telemetry.flush_trace()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+
+    events = trace_report.load_events(trace_path)
+    problems = trace_report.validate_flows(events)
+    assert not problems, problems
+    a = trace_report.analyze(events)
+    print(trace_report.summary_line(a))
+    print(
+        f"elastic_storm_check: ok — {failures} induced failures rolled back, "
+        f"{len(storm_dumps)} dump(s) (deduped repeat), streams byte-identical"
+    )
+    telemetry.reset()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

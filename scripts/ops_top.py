@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, List
@@ -95,6 +96,29 @@ def render(status: Dict[str, Any]) -> str:
                 f"  shard {sh.get('shard'):<3} sessions {sh.get('sessions', 0):<4} "
                 f"width {sh.get('width', 0):<4} pads {sh.get('pads', 0):<4} "
                 f"pending {sh.get('pending', 0):<5} flushes {sh.get('flushes', 0)}"
+            )
+    for ctl in status.get("elastic") or []:
+        burn = "  SLO BURNING" if ctl.get("slo_burning") else ""
+        lines.append(
+            f"elastic {ctl.get('plane')}: "
+            f"ticks {ctl.get('ticks', 0)}  "
+            f"migrations {ctl.get('migrations', 0)}  "
+            f"in flight {ctl.get('in_flight', 0)}  "
+            f"rollbacks {ctl.get('rollbacks', 0)}  "
+            f"failures {ctl.get('failures', 0)}{burn}"
+        )
+        last = ctl.get("last_action") or {}
+        if last:
+            ok = "ok" if last.get("ok") else "ROLLED BACK"
+            lines.append(
+                f"  last action: {last.get('action')} "
+                f"{last.get('session')} -> shard {last.get('to_shard')} ({ok})"
+            )
+        for e in ctl.get("loads") or []:
+            lines.append(
+                f"  shard {e.get('shard'):<3} load {e.get('load', 0):<5} "
+                f"pending {e.get('pending', 0):<5} "
+                f"sessions {e.get('sessions', 0):<4} width {e.get('width', 0)}"
             )
     for plane in status.get("serve") or []:
         closed = " (closed)" if plane.get("closed") else ""
@@ -176,6 +200,17 @@ def main() -> int:
                 print("\x1b[2J\x1b[H", end="")  # clear + home
             print(render(status))
         if args.once:
+            # An elastic deployment whose status surface lost the
+            # autoscaler block is a dead control loop — fail the smoke.
+            if os.environ.get("PERITEXT_ELASTIC", "") not in ("", "0") and not (
+                status.get("elastic")
+            ):
+                print(
+                    "ops_top: PERITEXT_ELASTIC is set but the status surface "
+                    "has no elastic block (autoscaler not running?)",
+                    file=sys.stderr,
+                )
+                return 1
             return 0
         time.sleep(args.interval)
 

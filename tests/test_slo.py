@@ -592,6 +592,56 @@ def test_sharded_plane_contributes_fleet_status():
     assert all("width" in sh and "pending" in sh for sh in occupied)
 
 
+def test_elastic_status_surface_and_ops_top(tmp_path):
+    from peritext_tpu.runtime.elastic import ElasticController
+    from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+    telemetry.enable()
+    changes = _author_stream()
+    plane = ShardedServePlane(2, start=False, batch_target=8)
+    s0 = plane.session("s0", replica="r0", shard=0)
+    s0.submit(changes)
+    assert plane.drain() == 0
+    ctl = ElasticController(plane, interval=3600.0, cooldown=0.0, start=False)
+    ctl.tick()
+    st = telemetry.status()
+    blocks = st.get("elastic") or []
+    assert blocks, st.keys()
+    blk = blocks[-1]
+    assert blk["ticks"] >= 1
+    assert blk["in_flight"] == 0 and blk["rollbacks"] == 0
+    assert any(e["sessions"] == 1 for e in blk["loads"])
+    path = str(tmp_path / "status.json")
+    assert telemetry.dump_status(path) == path
+    proc = subprocess.run(
+        [sys.executable, OPS_TOP, path, "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "elastic" in proc.stdout and "migrations" in proc.stdout
+    assert "shard 0" in proc.stdout
+    # With PERITEXT_ELASTIC=1 the renderer REQUIRES the autoscaler block:
+    # strip it and --once must fail loudly (a dead autoscaler must not
+    # pass the CI smoke), while the un-flagged render stays green.
+    st.pop("elastic", None)
+    bare = str(tmp_path / "bare.json")
+    with open(bare, "w") as f:
+        json.dump(st, f)
+    env = dict(os.environ, PERITEXT_ELASTIC="1")
+    proc = subprocess.run(
+        [sys.executable, OPS_TOP, bare, "--once"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert proc.returncode == 1, proc.stdout
+    env.pop("PERITEXT_ELASTIC")
+    proc = subprocess.run(
+        [sys.executable, OPS_TOP, bare, "--once"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    ctl.close()
+
+
 def test_status_flusher_writes_periodically(tmp_path):
     path = str(tmp_path / "live.json")
     telemetry.enable(status_path=path, metrics_interval=0.05)
