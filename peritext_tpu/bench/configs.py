@@ -586,6 +586,50 @@ def config9_elastic_serving() -> Dict[str, Any]:
     }
 
 
+def config10_doc_lifecycle() -> Dict[str, Any]:
+    """Multi-tenant document lifecycle: a watermark-bounded device fleet
+    serving a Zipf-skewed document population far larger than it can
+    hold (runtime/lifecycle.py), vs a resident-only control on identical
+    traffic.
+
+    The record is the tenancy ratio (documents served / peak device rows
+    held), the warm/cold admit-to-applied p95 split (cold = transparent
+    hydrate-on-submit, its own SLO-able histogram), and the lifecycle
+    protocol tallies — per-session byte-identity between the legs is
+    asserted in-harness.  Env knobs: CONFIG10_SESSIONS / ROUNDS /
+    CHANGES / DOC_LEN / SHARDS / WATERMARK; PERITEXT_LIFECYCLE_* tune
+    the reaper when attached via env instead.
+    """
+    from peritext_tpu.bench.workloads import time_lifecycle_ab
+
+    r = time_lifecycle_ab(
+        sessions=int(os.environ.get("CONFIG10_SESSIONS", "32")),
+        rounds=int(os.environ.get("CONFIG10_ROUNDS", "10")),
+        changes_per_round=int(os.environ.get("CONFIG10_CHANGES", "16")),
+        doc_len=int(os.environ.get("CONFIG10_DOC_LEN", "120")),
+        shards=int(os.environ.get("CONFIG10_SHARDS", "2")),
+        watermark=int(os.environ.get("CONFIG10_WATERMARK", "4")),
+    )
+    control, lifecycle = r["legs"]
+    return {
+        "config": 10,
+        "workload": f"{r['sessions']} Zipf-accessed docs over a "
+        f"{r['watermark']}-doc watermark, {r['shards']} shards, "
+        f"{r['rounds']} rounds x {r['changes_per_round']} changes, "
+        f"{r['doc_len']}-char docs",
+        "byte_identity": r["byte_identity"],
+        "ok": r["ok"],
+        "tenancy_ratio": r["tenancy_ratio"],
+        "control_peak_rows": control["peak_device_rows"],
+        "lifecycle_peak_rows": lifecycle["peak_device_rows"],
+        "warm_p95_ms": r["warm_p95_ms"],
+        "cold_start_p95_ms": r["cold_start_p95_ms"],
+        "cold_starts": lifecycle["cold_count"],
+        "evictions": (lifecycle.get("lifecycle_stats") or {}).get("evictions", 0),
+        "hydrations": (lifecycle.get("lifecycle_stats") or {}).get("hydrations", 0),
+    }
+
+
 CONFIGS = {
     1: config1_trace_replay,
     2: config2_fuzz_style,
@@ -596,6 +640,7 @@ CONFIGS = {
     7: config7_serving_plane,
     8: config8_sharded_serving,
     9: config9_elastic_serving,
+    10: config10_doc_lifecycle,
 }
 
 

@@ -259,6 +259,7 @@ def fuzz(
     serve: bool = False,
     serve_shards: int = 1,
     migrate_every: int = 0,
+    evict_every: int = 0,
 ) -> Dict[str, Any]:
     """Run the fuzz loop; raises :class:`FuzzError` with a replayable state.
 
@@ -309,6 +310,20 @@ def fuzz(
     rollback must keep every quiesce's convergence and byte-identity
     asserts green.
 
+    With ``evict_every`` (sharded serve mode only), every N iterations a
+    random session either **evicts** — durable checkpoint, device row
+    freed (runtime/lifecycle.py) — or, if already cold, **hydrates**
+    back through the full crash-safe protocol.  Cold sessions whose doc
+    generates traffic hydrate transparently on submit (the cold-start
+    path the lifecycle exists to serve).  Under chaos an installed fault
+    plan's ``doc_evict``/``doc_hydrate`` sites can fail any protocol
+    step; rollback must leave a failed evict resident and a failed
+    hydrate cold — the quiesce's warm-all pass retries from the durable
+    checkpoint — with every convergence and byte-identity assert green.
+    Combined with ``migrate_every``, migration racing eviction must
+    serialize: the elastic plane refuses cold sessions and the lifecycle
+    refuses parked (migrating) ones, both tolerated as skips.
+
     With ``nested``, a share of iterations drive the host structural plane
     (nested makeMap/makeList/set/del, second-list edits and marks) and every
     sync additionally asserts root-view and nested-list-span convergence.
@@ -325,6 +340,8 @@ def fuzz(
         raise ValueError(f"chaos_quiesce must be >= 1, got {chaos_quiesce}")
     if migrate_every and not (serve and serve_shards > 1):
         raise ValueError("migrate_every requires serve mode with shards > 1")
+    if evict_every and not (serve and serve_shards > 1):
+        raise ValueError("evict_every requires serve mode with shards > 1")
     chaos_plan = FaultPlan.from_spec(chaos, seed=seed) if chaos else None
     docs, all_patches, initial_change = generate_docs(initial_text, num_docs)
     if doc_factory is not Doc:
@@ -338,6 +355,8 @@ def fuzz(
 
     serve_plane = None
     serve_sessions: Dict[str, Any] = {}
+    lifecycle = None
+    lifecycle_errors: tuple = ()
     if serve and serve_shards > 1:
         # Sharded mode (runtime/serve_shard.py): the fuzz replicas are
         # replicas of the SAME document, spread round-robin across
@@ -368,6 +387,17 @@ def fuzz(
             serve_sessions[d.actor_id].submit([initial_change])
         if serve_plane.drain() != 0:
             raise RuntimeError("sharded plane failed to drain the genesis change")
+        if evict_every:
+            from peritext_tpu.runtime.lifecycle import (
+                DocLifecycle,
+                EvictionError,
+                HydrationError,
+            )
+
+            # Manual ticking (start=False) keeps the fuzz deterministic;
+            # the evict_every block below IS the policy loop.
+            lifecycle = DocLifecycle(serve_plane, start=False, keep=2)
+            lifecycle_errors = (EvictionError, HydrationError)
     elif serve:
         from peritext_tpu.ops import TpuUniverse
         from peritext_tpu.runtime.serve import ServePlane
@@ -395,7 +425,39 @@ def fuzz(
 
     def serve_submit(actor_id: str, changes) -> None:
         if serve_plane is not None and changes:
-            serve_sessions[actor_id].submit(list(changes))
+            try:
+                serve_sessions[actor_id].submit(list(changes))
+            except lifecycle_errors:
+                # An injected doc_hydrate fault failed the transparent
+                # cold-start mid-submit; the session stays cold and the
+                # durable log redelivers at the next quiesce's warm-all
+                # pass (rollback left nothing half-applied).
+                evict_stats["cold_submit_failures"] += 1
+
+    def serve_warm_all() -> None:
+        """Hydrate every cold session before a quiesce: plane.clock()/
+        spans() read the device row, and the catch-up redelivery bypasses
+        the cold trap via ``._inner``.  Hydration under an installed
+        fault plan can fail (``doc_hydrate`` site) — retry from the
+        durable checkpoint; a session that stays cold past the budget is
+        a real availability bug."""
+        if lifecycle is None:
+            return
+        for d in docs:
+            sess = serve_sessions[d.actor_id]
+            for _ in range(8):
+                if not sess._cold:
+                    break
+                try:
+                    lifecycle.hydrate(f"s-{d.actor_id}")
+                except lifecycle_errors:
+                    continue
+            else:
+                fail(
+                    f"session s-{d.actor_id} still cold after 8 hydration "
+                    "attempts",
+                    {"evict_stats": dict(evict_stats)},
+                )
 
     def serve_check(docs_synced: bool = True) -> None:
         """Catch each serve replica up to ITS doc's clock (dedup-idempotent
@@ -414,6 +476,7 @@ def fuzz(
         if serve_plane is None:
             return
         if serve_shards > 1:
+            serve_warm_all()
             frontier = log.clock()
             for d in docs:
                 missing = log.missing_changes(
@@ -543,7 +606,13 @@ def fuzz(
 
     done = 0
     max_doc_len = 0
-    migrate_stats = {"attempts": 0, "migrations": 0, "rollbacks": 0}
+    migrate_stats = {
+        "attempts": 0, "migrations": 0, "rollbacks": 0, "skipped_cold": 0,
+    }
+    evict_stats = {
+        "attempts": 0, "evictions": 0, "hydrations": 0, "rollbacks": 0,
+        "skipped": 0, "cold_submit_failures": 0,
+    }
     # True while chaotic syncs have happened since the last fault-free
     # quiesce (drives both the heartbeat wording and the mandatory final
     # quiesce — `done % chaos_quiesce` alone misses a no-op last iteration).
@@ -668,6 +737,39 @@ def fuzz(
                 migrate_stats["migrations"] += 1
             except _elastic.MigrationError:
                 migrate_stats["rollbacks"] += 1
+            except ValueError:
+                if not evict_every:
+                    raise
+                # Migration racing eviction: the elastic plane refuses an
+                # evicted (cold) session outright — the defined
+                # serialization with the lifecycle, not a failure.
+                migrate_stats["skipped_cold"] += 1
+        if evict_every and done % evict_every == 0:
+            # Multi-tenant lifecycle under fire (ISSUE 20): every N
+            # iterations a random session either evicts (durable
+            # checkpoint + device row freed) or, if already cold,
+            # hydrates back through the full crash-safe protocol
+            # (runtime/lifecycle.py).  Under chaos an installed fault
+            # plan's doc_evict/doc_hydrate sites can fail any step — a
+            # failed evict rolls back resident, a failed hydrate stays
+            # cold for the quiesce's warm-all retry, and the convergence
+            # + byte-identity asserts must hold either way.
+            victim = docs[rng.randrange(len(docs))]
+            vsess = serve_sessions[victim.actor_id]
+            evict_stats["attempts"] += 1
+            try:
+                if vsess._cold:
+                    lifecycle.hydrate(f"s-{victim.actor_id}")
+                    evict_stats["hydrations"] += 1
+                else:
+                    lifecycle.evict(f"s-{victim.actor_id}")
+                    evict_stats["evictions"] += 1
+            except lifecycle_errors:
+                evict_stats["rollbacks"] += 1
+            except ValueError:
+                # Racing a live migration (parked session): the
+                # lifecycle serializes by refusing, not deadlocking.
+                evict_stats["skipped"] += 1
         # Progress AFTER the iteration's checks: a soak line only claims
         # "ok" for iterations that actually converged — chaotic
         # non-quiesce iterations still emit a heartbeat (a wedged soak must
@@ -714,6 +816,9 @@ def fuzz(
         "final_spans": docs[0].get_text_with_formatting(["text"]),
         "serve_stats": dict(serve_plane.stats) if serve_plane is not None else None,
         "migrate_stats": migrate_stats if migrate_every else None,
+        "evict_stats": dict(evict_stats, lifecycle=dict(lifecycle.stats))
+        if evict_every
+        else None,
     }
 
 
@@ -754,6 +859,16 @@ def _main() -> None:
         "shard_migrate site can fail any step and the rollback must keep "
         "every quiesce's convergence + byte-identity asserts green "
         "(0 = never)",
+    )
+    parser.add_argument(
+        "--evict-every", type=int, default=0, metavar="N",
+        help="with --serve --shards K: every N iterations a random session "
+        "evicts (durable checkpoint, device row freed) or hydrates back "
+        "through the full lifecycle protocol (runtime/lifecycle.py); cold "
+        "sessions also hydrate transparently on submit; under --chaos a "
+        "fault plan's doc_evict/doc_hydrate sites can fail any step and "
+        "rollback must keep every quiesce's convergence + byte-identity "
+        "asserts green (0 = never)",
     )
     parser.add_argument(
         "--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC, default=None, metavar="SPEC",
@@ -835,6 +950,7 @@ def _main() -> None:
             serve=args.serve or args.shards > 1,
             serve_shards=args.shards,
             migrate_every=args.migrate_every,
+            evict_every=args.evict_every,
         )
     except FuzzError as err:
         path = os.path.join(args.trace_dir, f"fail-seed{args.seed}.json")
@@ -853,7 +969,23 @@ def _main() -> None:
         ms = result["migrate_stats"]
         print(
             f"migrate: {ms['migrations']}/{ms['attempts']} sessions moved "
-            f"live ({ms['rollbacks']} rolled back)",
+            f"live ({ms['rollbacks']} rolled back, {ms['skipped_cold']} "
+            f"skipped cold)",
+            flush=True,
+        )
+    if result.get("evict_stats"):
+        es = result["evict_stats"]
+        lc = es["lifecycle"]
+        print(
+            f"lifecycle: {es['evictions']} evicted / {es['hydrations']} "
+            f"explicitly hydrated over {es['attempts']} attempts "
+            f"({es['rollbacks']} rolled back, {es['skipped']} skipped racing "
+            f"migration, {es['cold_submit_failures']} cold submits failed "
+            f"over to quiesce); protocol totals: "
+            f"{lc['evictions']} evictions, {lc['hydrations']} hydrations, "
+            f"{lc['corrupt_fallbacks']} corrupt fallbacks, "
+            f"{lc['full_replays']} full replays, "
+            f"{lc['replayed_changes']} changes replayed",
             flush=True,
         )
     if args.growth:

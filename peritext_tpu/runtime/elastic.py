@@ -88,6 +88,14 @@ def migrate_session(plane: Any, name: str, target_shard: int) -> None:
             raise ValueError(f"session {name!r} is already on shard {target_shard}")
         if sess._parked is not None:
             raise ValueError(f"session {name!r} is already migrating")
+        if getattr(sess, "_cold", False):
+            # Migration-vs-eviction serialization (runtime/lifecycle.py):
+            # a cold session has no device row to move — the caller must
+            # hydrate first.  Both protocols park under the facade lock,
+            # so a mid-eviction session surfaces as "already migrating".
+            raise ValueError(
+                f"session {name!r} is evicted (cold); hydrate before migrating"
+            )
         source_index = sess.shard
         source_slot = plane.shards[source_index]
         target_slot = plane.shards[target_shard]
@@ -413,7 +421,9 @@ class ElasticController:
         with plane._lock:
             candidates = [
                 s for s in plane._sessions.values()
-                if s.shard == shard_index and s._parked is None
+                if s.shard == shard_index
+                and s._parked is None
+                and not getattr(s, "_cold", False)
             ]
             if not candidates:
                 return None

@@ -29,6 +29,16 @@ site                      fired from
                           protocol step — drain, export, provision, import,
                           commit — and drop/dup/reorder filter the parked
                           submissions replayed onto the target shard)
+``doc_evict``             session eviction to durable checkpoint
+                          (runtime/lifecycle.py; ``fail``/``wedge`` hit every
+                          protocol step — drain, export, persist, commit —
+                          and ``corrupt=N`` truncates the just-written
+                          generation npz, the crash-corruption drill)
+``doc_hydrate``           cold-session hydration from checkpoint
+                          (runtime/lifecycle.py; ``fail``/``wedge`` hit every
+                          protocol step — provision, load, import, replay,
+                          commit — and drop/dup/reorder filter the parked
+                          deliveries replayed at commit)
 ========================  ====================================================
 
 Schedules per site (all deterministic given the plan seed and call order):
@@ -73,6 +83,8 @@ KNOWN_SITES = (
     "log_append",
     "serve_admit",
     "shard_migrate",
+    "doc_evict",
+    "doc_hydrate",
 )
 
 _STAT_KEYS = ("fired", "failed", "wedged", "dropped", "duplicated", "reordered", "corrupted")

@@ -222,6 +222,7 @@ class Submission:
         "t_done",
         "fresh",
         "flush_seq",
+        "lat_class",
         "_range",
         "_event",
         "_patches",
@@ -234,6 +235,12 @@ class Submission:
         self.ctx = ctx
         self.t0 = time.perf_counter()
         self.t_done: Optional[float] = None  # perf_counter at resolution
+        # Warm/cold admission class (runtime/lifecycle.py): "cold" when this
+        # submission's admission had to hydrate an evicted doc first, "warm"
+        # for lifecycle-managed resident admissions, None otherwise.  Feeds
+        # the e2e.admit_to_applied_{warm,cold} split histograms so cold-start
+        # SLOs are first-class PERITEXT_SLO objectives.
+        self.lat_class: Optional[str] = None
         self.fresh: Optional[List[Change]] = None
         self.flush_seq: Optional[int] = None
         self._range: Tuple[int, int] = (0, 0)
@@ -928,6 +935,10 @@ class ServePlane:
             elapsed = now - sub.t0
             if telemetry.enabled:
                 telemetry.observe("e2e.admit_to_applied", elapsed)
+                if sub.lat_class is not None:
+                    telemetry.observe(
+                        f"e2e.admit_to_applied_{sub.lat_class}", elapsed
+                    )
             if elapsed > window:
                 misses += 1
                 self.stats["deadline_misses"] += 1

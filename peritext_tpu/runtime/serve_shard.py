@@ -65,6 +65,7 @@ from peritext_tpu.runtime.pubsub import Publisher
 from peritext_tpu.runtime.serve import (
     ServePlane,
     ServeSession,
+    Submission,
     _bucket_pow2,
     _env_int,
 )
@@ -189,6 +190,12 @@ class ShardSession:
         # inner session.  None on the hot path, so submit()/_deliver()
         # pay exactly one attribute check when elasticity is off.
         self._parked: Optional[List[Tuple[List[Change], Optional[ParkedSubmission]]]] = None
+        # Document-lifecycle state (runtime/lifecycle.py): True while this
+        # session's replica row is evicted to a durable checkpoint.  A
+        # client submit to a cold session transparently hydrates it; cross-
+        # shard deliveries to a cold session drop (the group log holds
+        # them, and hydration replays the tail through the admission gate).
+        self._cold = False
 
     @property
     def patch_log(self):
@@ -209,6 +216,23 @@ class ShardSession:
         per-link chaos (drop/dup/reorder) lands on each sibling's
         admission gate independently."""
         changes = list(changes)
+        lc = self._plane.lifecycle
+        cold = False
+        if lc is not None and self._cold:
+            # Hydrate-on-submit BEFORE recording: the hydration tail
+            # replays whatever the logs already hold, so this batch must
+            # not be logged yet — its patches belong to the Submission
+            # future minted below, not to the anonymous tail replay.
+            # ``pending=`` additionally excludes the batch from the tail
+            # should it already be logged (a parked replay re-entering).
+            lc.ensure_resident(self, pending=changes)
+            cold = True
+        if lc is not None and changes:
+            # Lifecycle log + LRU touch BEFORE admission (mirrors the
+            # group-log record-then-admit contract): a change must be
+            # logged before any admission-side chaos can drop it, so a
+            # later hydration can still replay it.
+            lc._observe(self, changes)
         if self.doc is not None and changes:
             # Record into the group log BEFORE admission: a forked actor
             # history must reject loudly up front, never after the local
@@ -218,6 +242,8 @@ class ShardSession:
             sub = self._plane._park(self, changes)
         else:
             sub = self._inner.submit(changes)
+            if lc is not None and isinstance(sub, Submission):
+                sub.lat_class = "cold" if cold else "warm"
         if self.doc is not None and changes:
             self._plane._fan_out(self, changes)
         if wait:
@@ -225,9 +251,13 @@ class ShardSession:
         return sub
 
     def _deliver(self, changes: Sequence[Change]) -> None:
-        """Cross-shard delivery entry (live fan-out, anti-entropy): parks
-        during a migration of this session, else straight to the
-        shard-local admission lane."""
+        """Cross-shard delivery entry (live fan-out, anti-entropy): drops
+        while this session is evicted (the group log already holds the
+        change — hydration replays the contiguous tail), parks during a
+        migration of this session, else straight to the shard-local
+        admission lane."""
+        if self._cold:
+            return
         if self._parked is not None:
             self._plane._park(self, list(changes), deliver=True)
             return
@@ -331,6 +361,15 @@ class ShardedServePlane:
             from peritext_tpu.runtime.elastic import ElasticController
 
             self.elastic = ElasticController(self, start=start)
+        # Multi-tenant document lifecycle (ISSUE 20): PERITEXT_LIFECYCLE=1
+        # attaches the LRU evict/hydrate reaper; lifecycle.py takes the
+        # plane as an argument, same no-cycle pattern as elastic.  Off by
+        # default — submit/_deliver then pay one attribute check each.
+        self.lifecycle: Any = None
+        if os.environ.get("PERITEXT_LIFECYCLE", "") not in ("", "0"):
+            from peritext_tpu.runtime.lifecycle import DocLifecycle
+
+            self.lifecycle = DocLifecycle(self, start=start)
 
     def _status(self) -> Dict[str, Any]:
         with self._lock:
@@ -543,6 +582,11 @@ class ShardedServePlane:
         load — and a doc group's members — spread over the fleet).
         ``doc`` names the replication group for cross-shard anti-entropy;
         the remaining kwargs are :meth:`ServePlane.session`'s."""
+        if self.lifecycle is not None:
+            # Capacity-pressure eviction BEFORE the facade lock (the evict
+            # protocol takes it): admitting this session must not push the
+            # resident population past the lifecycle watermark.
+            self.lifecycle._admission_pressure(exclude=name)
         with self._lock:
             if name in self._sessions:
                 raise ValueError(f"session {name!r} already exists")
@@ -581,6 +625,8 @@ class ShardedServePlane:
                 group["publisher"].subscribe(
                     name, lambda change, s=sess: s._deliver([change])
                 )
+            if self.lifecycle is not None:
+                self.lifecycle._admitted(sess)
             if telemetry.enabled:
                 telemetry.gauge("serve.sessions", len(self._sessions))
                 telemetry.counter(f"serve.shard.{shard}.sessions")
@@ -631,6 +677,19 @@ class ShardedServePlane:
                 if telemetry.enabled:
                     telemetry.counter("elastic.parked_deliveries")
                 return wrapper
+            cold = sess._cold
+        if cold:
+            # The protocol that parked us committed an EVICTION while this
+            # call raced it: a delivery simply drops (the group log holds
+            # it for the hydration tail); a client submit hydrates and
+            # admits like any other cold submit.
+            if deliver:
+                return None
+            if self.lifecycle is not None:
+                # pending=: this batch is already in the logs (recorded
+                # before parking), but its patches belong to the future
+                # minted just below — keep it out of the hydration tail.
+                self.lifecycle.ensure_resident(sess, pending=changes)
         return sess._inner.submit(changes)
 
     # -- cross-shard anti-entropy --------------------------------------------
@@ -684,9 +743,11 @@ class ShardedServePlane:
         pending: List[Tuple[ShardSession, List[Change]]] = []
         for group, members in groups:
             for sess in members:
-                if sess._parked is not None:
+                if sess._parked is not None or sess._cold:
                     # Mid-migration: the commit replays the group-log tail
                     # itself; redelivering here would race the row handoff.
+                    # Cold (evicted): the row is gone — hydration replays
+                    # the tail, and redelivering would just rehydrate it.
                     continue
                 shard = self.shards[sess.shard]
                 if shard.plane is None:
@@ -737,6 +798,8 @@ class ShardedServePlane:
             plane.flush_and_wait(timeout=timeout)
 
     def close(self, reject_pending: bool = True) -> None:
+        if self.lifecycle is not None:
+            self.lifecycle.close()
         if self.elastic is not None:
             self.elastic.close()
         for plane in self._planes():

@@ -1,0 +1,250 @@
+#!/usr/bin/env python3
+"""CI drill: a seeded evict/hydrate failure storm must recover byte-identically.
+
+Runs a 2-shard serving fleet with a :class:`DocLifecycle` attached
+through the crash drills the lifecycle claims to survive, with the
+``doc_evict``/``doc_hydrate`` fault sites armed and PERITEXT_BLACKBOX
+set, then asserts:
+
+- an eviction-failure storm raises EvictionError per induced failure,
+  rolls back to a resident, authoritative session, and writes exactly
+  one rate-limited black-box dump per FAILING DOCUMENT (a repeat
+  failure on the same doc within the cooldown dedupes — counted, not
+  dumped);
+- the kill-between-checkpoint-and-free drill (commit gate fails AFTER
+  the generation is durable) leaves the session resident with a stale
+  generation on disk, and the next evict/hydrate round-trip supersedes
+  it newest-generation-first;
+- the corrupt-latest drill (``doc_evict:corrupt=1`` truncates the
+  just-written npz) makes the next hydrate fall back to the previous
+  generation and replay the missing suffix from the durable log
+  (``corrupt_fallbacks`` counted, recovery dump named);
+- a hydration failure rolls back to a still-cold session and the retry
+  lands;
+- after all drills every session's concatenated patch stream is
+  byte-identical to direct per-change ingest (the lifecycle
+  byte-identity contract, end to end);
+- with the tracer on, the flow-event graph validates
+  (scripts/trace_report.py schema pass) — ``lifecycle.evict`` /
+  ``lifecycle.hydrate`` lanes included.
+
+Exit 0 on success; any assertion failure exits non-zero.  CI runs it in
+the test-chaos-health job right after elastic_storm_check.py.
+"""
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("PERITEXT_LAUNCH_BACKOFF", "0.001")
+    os.environ.setdefault("PERITEXT_LAUNCH_RETRIES", "1")
+
+    blackbox_dir = os.environ.get("PERITEXT_BLACKBOX") or tempfile.mkdtemp(
+        prefix="peritext-lifecycle-"
+    )
+    trace_path = os.environ.get("PERITEXT_TRACE") or os.path.join(
+        blackbox_dir, "storm_trace.jsonl"
+    )
+
+    from peritext_tpu.oracle import Doc
+    from peritext_tpu.ops import TpuUniverse
+    from peritext_tpu.runtime import faults, telemetry
+    from peritext_tpu.runtime import lifecycle as lc_mod
+    from peritext_tpu.runtime.faults import FaultPlan
+    from peritext_tpu.runtime.lifecycle import (
+        DocLifecycle,
+        EvictionError,
+        HydrationError,
+    )
+    from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+    telemetry.reset()
+    telemetry.enable(trace=trace_path, blackbox=blackbox_dir)
+
+    def author(actor, n, seed):
+        d = Doc(actor)
+        genesis, _ = d.change(
+            [
+                {"path": [], "action": "makeList", "key": "text"},
+                {"path": ["text"], "action": "insert", "index": 0,
+                 "values": list(f"lifecycle drill {actor}")},
+            ]
+        )
+        changes = [genesis]
+        for i in range(n):
+            c, _ = d.change(
+                [{"path": ["text"], "action": "insert", "index": (seed + i) % 5,
+                  "values": [chr(ord("a") + (seed + i) % 26)]}]
+            )
+            changes.append(c)
+        return changes
+
+    names = [f"lc{i}" for i in range(3)]
+    streams = [author(n, 9, seed=20 + i) for i, n in enumerate(names)]
+
+    plane = ShardedServePlane(2, start=False, batch_target=64, deadline_ms=10**9)
+    lc = DocLifecycle(
+        plane, start=False, watermark=0, keep=2,
+        directory=tempfile.mkdtemp(prefix="peritext-lifecycle-ckpt-"),
+    )
+    sess = [
+        plane.session(f"s{i}", replica=names[i], record_stream=True)
+        for i in range(3)
+    ]
+    for i in range(3):
+        sess[i].submit(streams[i][:4])
+    assert plane.drain() == 0
+
+    # -- drill 1: the eviction-failure storm ---------------------------------
+    # The first 3 doc_evict chokepoint firings fail — s0's attempt, s0
+    # AGAIN (same dedupe key, inside the cooldown), then s1's attempt.
+    # Two failing documents -> exactly two dumps; the repeat -> one
+    # dedupe count.  Every failure must roll back to a resident session.
+    plan = FaultPlan(seed=7).with_site("doc_evict", fail=3)
+    failures = 0
+    with faults.injected(plan):
+        for victim in ("s0", "s0", "s1"):
+            try:
+                lc.evict(victim)
+                raise AssertionError(f"storm eviction of {victim} succeeded")
+            except EvictionError:
+                failures += 1
+            assert not plane._sessions[victim]._cold, (
+                f"failed eviction left {victim} cold"
+            )
+    assert failures == 3
+    assert plan.stats["doc_evict"]["failed"] == 3, plan.stats
+    assert lc.stats["evict_failures"] == 3
+
+    # -- drill 2: kill between checkpoint write and row free -----------------
+    # The commit gate (the LAST doc_evict chokepoint, after the
+    # generation is durable but before the device row frees) crashes:
+    # the session must stay resident and authoritative, with the stale
+    # generation on disk to be superseded by the next evict.
+    orig_fire = lc_mod.faults.fire
+    fired = {"n": 0}
+
+    def commit_gate_crash(site, **kw):
+        if site == "doc_evict":
+            fired["n"] += 1
+            if fired["n"] == 4:  # steps: drain, export, persist, COMMIT GATE
+                raise faults.FaultError("induced crash at the commit gate")
+        return orig_fire(site, **kw)
+
+    lc_mod.faults.fire = commit_gate_crash
+    try:
+        try:
+            lc.evict("s1")
+            raise AssertionError("commit-gate crash eviction succeeded")
+        except EvictionError:
+            pass
+    finally:
+        lc_mod.faults.fire = orig_fire
+    assert fired["n"] == 4, fired
+    assert not plane._sessions["s1"]._cold, "commit-gate crash left s1 cold"
+    stale = glob.glob(os.path.join(lc._doc_dir("s1"), "gen-*.npz"))
+    assert len(stale) == 1, f"expected the stale generation on disk, got {stale}"
+    # The next round-trip supersedes the stale generation newest-first.
+    sess[1].submit(streams[1][4:7])
+    assert plane.drain() == 0
+    lc.evict("s1")
+    gens = sorted(glob.glob(os.path.join(lc._doc_dir("s1"), "gen-*.npz")))
+    assert len(gens) == 2, gens
+    lc.hydrate("s1")
+
+    # -- drill 3: corrupt-latest generation ----------------------------------
+    # A clean round-trip first, so an older good generation exists; then
+    # the corrupt-on-write drill truncates the newest npz and the next
+    # hydrate must fall back a generation and replay the missing suffix
+    # from the durable log.
+    lc.evict("s0")
+    lc.hydrate("s0")
+    sess[0].submit(streams[0][4:7])
+    assert plane.drain() == 0
+    corrupt_plan = FaultPlan(seed=3).with_site("doc_evict", corrupt=1)
+    with faults.injected(corrupt_plan):
+        lc.evict("s0")
+    assert corrupt_plan.stats["doc_evict"]["corrupted"] == 1, corrupt_plan.stats
+    lc.hydrate("s0")
+    assert lc.stats["corrupt_fallbacks"] >= 1, lc.stats
+    assert lc.stats["full_replays"] == 0, lc.stats
+
+    # -- drill 4: hydration failure rolls back cold, retry lands -------------
+    lc.evict("s2")
+    hplan = FaultPlan(seed=11).with_site("doc_hydrate", fail=1)
+    with faults.injected(hplan):
+        try:
+            lc.hydrate("s2")
+            raise AssertionError("storm hydration of s2 succeeded")
+        except HydrationError:
+            pass
+        assert plane._sessions["s2"]._cold, "failed hydration left s2 resident"
+        lc.hydrate("s2")
+    assert hplan.stats["doc_hydrate"]["failed"] == 1, hplan.stats
+    assert lc.stats["hydrate_failures"] == 1
+
+    # -- the wall: byte-identity against direct per-change ingest ------------
+    sess[0].submit(streams[0][7:])
+    sess[1].submit(streams[1][7:])
+    sess[2].submit(streams[2][4:])
+    assert plane.drain() == 0
+    control = TpuUniverse(names)
+    want = {n: [] for n in names}
+    for i, n in enumerate(names):
+        for c in streams[i]:
+            out = control.apply_changes_with_patches({n: [c]})
+            want[n].extend(out[n])
+    for i, n in enumerate(names):
+        assert sess[i].patch_log == want[n], f"stream diverged for {n}"
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("blackbox.deduped", 0) >= 1, counters
+    dumps = sorted(glob.glob(os.path.join(blackbox_dir, "blackbox-*.json")))
+    evict_dumps = [d for d in dumps if "doc_evict_failed" in os.path.basename(d)]
+    assert len(evict_dumps) == 2, (
+        f"expected exactly 2 evict dumps (one per failing doc, commit-gate "
+        f"repeat deduped), got {evict_dumps}"
+    )
+    hydrate_dumps = [
+        d for d in dumps if "doc_hydrate_failed" in os.path.basename(d)
+    ]
+    assert len(hydrate_dumps) == 2, (
+        f"expected exactly 2 hydrate dumps (s0 corrupt recovery + s2 "
+        f"rollback), got {hydrate_dumps}"
+    )
+    with open(evict_dumps[-1]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "doc_evict_failed"
+    assert dump["info"]["session"] in ("s0", "s1"), dump["info"]
+
+    plane.close()
+    telemetry.flush_trace()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+
+    events = trace_report.load_events(trace_path)
+    problems = trace_report.validate_flows(events)
+    assert not problems, problems
+    a = trace_report.analyze(events)
+    print(trace_report.summary_line(a))
+    print(
+        f"lifecycle_storm_check: ok — {failures} storm failures + "
+        f"commit-gate crash rolled back resident, corrupt generation "
+        f"fell back and replayed, hydration failure retried, "
+        f"{len(evict_dumps)}+{len(hydrate_dumps)} dump(s) (repeats deduped), "
+        f"streams byte-identical"
+    )
+    telemetry.reset()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,30 @@ def render(status: Dict[str, Any]) -> str:
                 f"pending {e.get('pending', 0):<5} "
                 f"sessions {e.get('sessions', 0):<4} width {e.get('width', 0)}"
             )
+    for lc in status.get("lifecycle") or []:
+        ratio = lc.get("tenancy_ratio")
+        cold = lc.get("cold_start_p95_ms")
+        lines.append(
+            f"lifecycle {lc.get('plane')}: "
+            f"docs {lc.get('docs', 0)} over {lc.get('device_rows', 0)} rows "
+            f"(tenancy {ratio if ratio is not None else '?'}x)  "
+            f"resident {lc.get('resident', 0)}  evicted {lc.get('evicted', 0)}  "
+            f"watermark {lc.get('watermark', 0) or 'off'}"
+        )
+        lines.append(
+            f"  evictions {lc.get('evictions', 0)}  "
+            f"hydrations {lc.get('hydrations', 0)}  "
+            f"rollbacks {lc.get('rollbacks', 0)}  "
+            f"corrupt fallbacks {lc.get('corrupt_fallbacks', 0)}  "
+            f"full replays {lc.get('full_replays', 0)}  "
+            f"cold-start p95 {f'{cold:.1f}ms' if cold is not None else '-'}"
+        )
+        last = lc.get("last_eviction") or {}
+        if last:
+            lines.append(
+                f"  last eviction: {last.get('session')} "
+                f"({last.get('reason', '?')}, shard {last.get('shard', '?')})"
+            )
     for plane in status.get("serve") or []:
         closed = " (closed)" if plane.get("closed") else ""
         lines.append(
@@ -208,6 +232,18 @@ def main() -> int:
                 print(
                     "ops_top: PERITEXT_ELASTIC is set but the status surface "
                     "has no elastic block (autoscaler not running?)",
+                    file=sys.stderr,
+                )
+                return 1
+            # Same contract for the document-lifecycle reaper: a managed
+            # fleet whose status lost the lifecycle block is a dead
+            # evict/hydrate loop — docs pile up resident until OOM.
+            if os.environ.get("PERITEXT_LIFECYCLE", "") not in ("", "0") and not (
+                status.get("lifecycle")
+            ):
+                print(
+                    "ops_top: PERITEXT_LIFECYCLE is set but the status "
+                    "surface has no lifecycle block (reaper not running?)",
                     file=sys.stderr,
                 )
                 return 1

@@ -376,6 +376,8 @@ def _chaos_workload(seed, tmp_path, run_tag):
         .with_site("queue_flush", fail=1)
         .with_site("log_append", fail=1)
         .with_site("checkpoint_write", corrupt=1)
+        .with_site("doc_evict", fail=1)
+        .with_site("doc_hydrate", fail=1)
     )
     with faults.injected(plan):
         # device_launch: 2 injected failures absorbed by the retry budget.
@@ -402,6 +404,30 @@ def _chaos_workload(seed, tmp_path, run_tag):
         log.append(change)
         # checkpoint_write: the corrupt-on-write drill consumes its event.
         save_universe(uni, str(tmp_path / f"snap-{run_tag}"))
+        # doc_evict / doc_hydrate: each protocol fails once (rolled back),
+        # then the retry lands — runtime/lifecycle.py.
+        from peritext_tpu.runtime.lifecycle import (
+            DocLifecycle, EvictionError, HydrationError,
+        )
+        from peritext_tpu.runtime.serve_shard import ShardedServePlane
+
+        plane = ShardedServePlane(
+            1, start=False, batch_target=64, deadline_ms=10**9,
+            name=f"chaos-{run_tag}",
+        )
+        lc = DocLifecycle(
+            plane, start=False, watermark=0,
+            directory=str(tmp_path / f"lc-{run_tag}"),
+        )
+        plane.session("cs", "chaos-doc").submit([_genesis_change()])
+        plane.drain()
+        with pytest.raises(EvictionError):
+            lc.evict("cs")
+        lc.evict("cs")
+        with pytest.raises(HydrationError):
+            lc.hydrate("cs")
+        lc.hydrate("cs")
+        plane.close()
     stats = {site: dict(v) for site, v in plan.stats.items()}
     counters = telemetry.snapshot()["counters"]
     telemetry.reset()
@@ -438,7 +464,12 @@ def test_fault_stats_mirror_registry_exactly(tmp_path):
     assert counters_a["ingest.launch_retries"] == 2
     assert counters_a["ingest.launch_failures"] == 2
     assert counters_a["queue.reenqueues"] == 2
-    assert counters_a["ingest.launches"] == 1
+    # Two successful launches: the bare universe genesis + the serving
+    # plane's genesis drain in the lifecycle exercise (evict drains an
+    # empty lane; hydrate restores from checkpoint, no replay launch).
+    assert counters_a["ingest.launches"] == 2
+    assert stats_a["doc_evict"]["failed"] == 1
+    assert stats_a["doc_hydrate"]["failed"] == 1
 
 
 # ---------------------------------------------------------------------------
